@@ -1,0 +1,20 @@
+"""go_ibft_tpu: a TPU-native IBFT 2.0 consensus framework.
+
+A from-scratch re-design of the capability set of 0xPolygon/go-ibft
+(reference mounted at /root/reference) for TPU hardware:
+
+- Host side: an asyncio consensus engine (``go_ibft_tpu.core``) driving the
+  IBFT 2.0 state machine — branchy, latency-bound control flow stays off the
+  accelerator, mirroring the reference's split between the state machine
+  (reference core/ibft.go) and expensive predicates (core/backend.go Verifier).
+- Device side: the O(N)-per-phase data plane — Keccak-256 hashing, ECDSA
+  secp256k1 / BLS12-381 signature verification and voting-power quorum
+  reduction — runs as jit/vmap-batched JAX ops (``go_ibft_tpu.ops``) draining a
+  whole round's message store in one fixed-shape batch instead of the
+  reference's per-message sequential verifies.
+- Scale: ``go_ibft_tpu.parallel`` shards verification batches over a
+  ``jax.sharding.Mesh`` and provides a lock-step multi-validator cluster
+  simulation where "multicast" is an all_gather over ICI.
+"""
+
+__version__ = "0.1.0"

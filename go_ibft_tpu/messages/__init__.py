@@ -1,0 +1,66 @@
+"""Message layer: wire schema, dedup store, events, helpers.
+
+TPU-native re-design of the reference's L1+L2 (messages/ package): see
+SURVEY.md §1.  The wire codec produces signing bytes byte-identical to the
+reference's protobuf marshaling for interop.
+"""
+
+from .events import EventManager, Subscription, SubscriptionDetails
+from .helpers import (
+    CommittedSeal,
+    WrongCommitMessageTypeError,
+    are_valid_pc_messages,
+    extract_commit_hash,
+    extract_committed_seal,
+    extract_committed_seals,
+    extract_last_prepared_proposal,
+    extract_latest_pc,
+    extract_prepare_hash,
+    extract_proposal,
+    extract_proposal_hash,
+    extract_round_change_certificate,
+    has_unique_senders,
+)
+from .store import MessageStore
+from .wire import (
+    CommitMessage,
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    PrepareMessage,
+    PrePrepareMessage,
+    Proposal,
+    RoundChangeCertificate,
+    RoundChangeMessage,
+    View,
+)
+
+__all__ = [
+    "CommitMessage",
+    "CommittedSeal",
+    "EventManager",
+    "IbftMessage",
+    "MessageStore",
+    "MessageType",
+    "PreparedCertificate",
+    "PrepareMessage",
+    "PrePrepareMessage",
+    "Proposal",
+    "RoundChangeCertificate",
+    "RoundChangeMessage",
+    "Subscription",
+    "SubscriptionDetails",
+    "View",
+    "WrongCommitMessageTypeError",
+    "are_valid_pc_messages",
+    "extract_commit_hash",
+    "extract_committed_seal",
+    "extract_committed_seals",
+    "extract_last_prepared_proposal",
+    "extract_latest_pc",
+    "extract_prepare_hash",
+    "extract_proposal",
+    "extract_proposal_hash",
+    "extract_round_change_certificate",
+    "has_unique_senders",
+]

@@ -1,0 +1,156 @@
+"""Event manager: pub/sub signaling for message arrival.
+
+Re-design of the reference's goroutine-per-subscription event plumbing
+(messages/event_manager.go:13-129, messages/event_subscription.go:7-84) on
+asyncio.  Semantics preserved exactly (SURVEY.md §2 #6):
+
+- **Non-blocking, coalescing notify**: the reference pushes into a buffered
+  channel and drops when full (event_subscription.go:72-84); here each
+  subscription owns a bounded deque — excess notifications coalesce.  This is
+  safe because subscribers always re-check the store after waking (the engine
+  re-validates quorum on every wake).
+- **Min-round matching**: a subscription either matches its round exactly or
+  treats it as a lower bound (event_subscription.go:45-69).
+- **Subscribe-then-recheck**: closing the "message arrived before we
+  subscribed" race is the *engine's* job (reference core/ibft.go:1286-1298);
+  the manager only guarantees no notification is lost-without-wakeup.
+
+The reference spawns one goroutine per subscription to forward notifications;
+on asyncio no forwarding task is needed — ``Subscription.wait`` consumes the
+deque directly, so there is nothing to leak (goleak parity for free).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .wire import MessageType, View
+
+
+def _running_loop_or_none() -> Optional[asyncio.AbstractEventLoop]:
+    try:
+        return asyncio.get_running_loop()
+    except RuntimeError:
+        return None
+
+
+@dataclass
+class SubscriptionDetails:
+    """Requested subscription filter (reference messages/event_manager.go:42-58)."""
+
+    message_type: MessageType
+    view: View
+    # Kept for API parity with the reference; the reference never consults it
+    # when matching events (event_subscription.go:45-69).
+    min_num_messages: int = 0
+    has_min_round: bool = False
+
+
+@dataclass
+class Subscription:
+    """A live subscription handle.
+
+    ``wait()`` returns the round number carried by the next matching event, or
+    ``None`` once the subscription is closed.  Notifications beyond the buffer
+    coalesce (the subscriber re-reads the store on wake anyway).
+
+    Wakeups are thread-safe: an embedder may push messages (and therefore
+    signal events) from network threads while the engine's event loop awaits
+    ``wait()`` — the owning loop is captured at subscription time and woken
+    via ``call_soon_threadsafe`` when signaled from outside it.
+    """
+
+    id: int
+    details: SubscriptionDetails
+    _rounds: deque = field(default_factory=lambda: deque(maxlen=2))
+    _wakeup: asyncio.Event = field(default_factory=asyncio.Event)
+    _closed: bool = False
+    _loop: Optional[asyncio.AbstractEventLoop] = field(
+        default_factory=lambda: _running_loop_or_none()
+    )
+
+    def _set_wakeup(self) -> None:
+        if self._loop is not None and _running_loop_or_none() is not self._loop:
+            try:
+                self._loop.call_soon_threadsafe(self._wakeup.set)
+            except RuntimeError:
+                # Owning loop already closed; nobody is waiting.
+                pass
+        else:
+            self._wakeup.set()
+
+    def _event_supported(self, message_type: MessageType, view: View) -> bool:
+        """Match filter (reference messages/event_subscription.go:45-69)."""
+        if view.height != self.details.view.height:
+            return False
+        if self.details.has_min_round:
+            if view.round < self.details.view.round:
+                return False
+        else:
+            if view.round != self.details.view.round:
+                return False
+        return message_type == self.details.message_type
+
+    def push_event(self, message_type: MessageType, view: View) -> None:
+        """Non-blocking notify (reference messages/event_subscription.go:72-84)."""
+        if self._closed or not self._event_supported(message_type, view):
+            return
+        self._rounds.append(view.round)
+        self._set_wakeup()
+
+    def close(self) -> None:
+        self._closed = True
+        self._set_wakeup()
+
+    async def wait(self) -> Optional[int]:
+        """Await the next matching event's round; ``None`` after close."""
+        while True:
+            if self._rounds:
+                round_ = self._rounds.popleft()
+                if not self._rounds and not self._closed:
+                    self._wakeup.clear()
+                return round_
+            if self._closed:
+                return None
+            await self._wakeup.wait()
+
+
+class EventManager:
+    """Subscription registry (reference messages/event_manager.go:13-129)."""
+
+    def __init__(self) -> None:
+        self._subscriptions: dict[int, Subscription] = {}
+        self._ids = itertools.count(1)
+
+    @property
+    def num_subscriptions(self) -> int:
+        return len(self._subscriptions)
+
+    def subscribe(self, details: SubscriptionDetails) -> Subscription:
+        """Register a listener (reference messages/event_manager.go:61-83)."""
+        sub = Subscription(id=next(self._ids), details=details)
+        self._subscriptions[sub.id] = sub
+        return sub
+
+    def cancel_subscription(self, sub_id: int) -> None:
+        """Stop one subscription (reference messages/event_manager.go:86-95)."""
+        sub = self._subscriptions.pop(sub_id, None)
+        if sub is not None:
+            sub.close()
+
+    def close(self) -> None:
+        """Cancel all subscriptions (reference messages/event_manager.go:98-107)."""
+        for sub in self._subscriptions.values():
+            sub.close()
+        self._subscriptions.clear()
+
+    def signal_event(self, message_type: MessageType, view: View) -> None:
+        """Alert all matching listeners (reference messages/event_manager.go:110-129)."""
+        if not self._subscriptions:
+            return
+        for sub in list(self._subscriptions.values()):
+            sub.push_event(message_type, view)

@@ -1,0 +1,219 @@
+"""Dedup message store with quorum-event signaling.
+
+Re-design of the reference's messages/messages.go:10-323: a per-type store
+keyed ``type -> height -> round -> sender`` (one message per sender per view —
+the Byzantine spam defense), validity-filtered reads that prune invalid
+entries, height GC, and the best-RCC / most-RC queries.
+
+Differences from the reference, by design:
+
+- Thread-safe via per-type ``threading.RLock`` so an embedder may feed
+  ``add_message`` from network threads while the asyncio engine drains.
+- ``get_valid_messages`` returns messages in deterministic insertion order
+  (Python dicts preserve it) instead of Go's random map order, which makes
+  batched device verification reproducible.
+- An optional *device mirror* hook: the store exposes ``snapshot_view`` which
+  hands the batch verifier one contiguous list per (view, type) so quorum
+  checks drain a single padded batch (SURVEY.md §2 #5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from .events import EventManager, Subscription, SubscriptionDetails
+from .wire import IbftMessage, MessageType, View
+
+# sender -> message (one message per sender per view)
+_SenderMap = dict[bytes, IbftMessage]
+# round -> sender map
+_RoundMap = dict[int, _SenderMap]
+# height -> round map
+_HeightMap = dict[int, _RoundMap]
+
+
+class MessageStore:
+    """Height/round/sender-keyed dedup store (reference messages/messages.go:10-22)."""
+
+    def __init__(self) -> None:
+        self._event_manager = EventManager()
+        self._locks: dict[MessageType, threading.RLock] = {
+            t: threading.RLock() for t in MessageType
+        }
+        self._maps: dict[MessageType, _HeightMap] = {t: {} for t in MessageType}
+
+    # -- subscriptions ------------------------------------------------------
+
+    def subscribe(self, details: SubscriptionDetails) -> Subscription:
+        """Create a message-event subscription (reference messages/messages.go:25-27)."""
+        return self._event_manager.subscribe(details)
+
+    def unsubscribe(self, sub_id: int) -> None:
+        """Cancel a subscription (reference messages/messages.go:30-32)."""
+        self._event_manager.cancel_subscription(sub_id)
+
+    def signal_event(self, message_type: MessageType, view: View) -> None:
+        """Alert subscribers of a message event (reference messages/messages.go:68-72)."""
+        self._event_manager.signal_event(message_type, view.copy())
+
+    def close(self) -> None:
+        """Shut down the event manager (reference messages/messages.go:75-77)."""
+        self._event_manager.close()
+
+    # -- modifiers ----------------------------------------------------------
+
+    def add_message(self, message: IbftMessage) -> None:
+        """Insert, deduplicating by sender (reference messages/messages.go:54-65).
+
+        A later message from the same sender for the same view overwrites the
+        earlier one, exactly as the reference's map assignment does.
+        """
+        if message.view is None:
+            return
+        with self._locks[message.type]:
+            height_map = self._maps[message.type]
+            round_map = height_map.setdefault(message.view.height, {})
+            sender_map = round_map.setdefault(message.view.round, {})
+            sender_map[message.sender] = message
+
+    def prune_by_height(self, height: int) -> None:
+        """Drop all messages below ``height`` (reference messages/messages.go:123-148)."""
+        for message_type in MessageType:
+            with self._locks[message_type]:
+                height_map = self._maps[message_type]
+                for stale in [h for h in height_map if h < height]:
+                    del height_map[stale]
+
+    # -- fetchers -----------------------------------------------------------
+
+    def num_messages(self, view: View, message_type: MessageType) -> int:
+        """Count stored messages for a view (reference messages/messages.go:96-119)."""
+        with self._locks[message_type]:
+            sender_map = self._maps[message_type].get(view.height, {}).get(view.round)
+            return len(sender_map) if sender_map else 0
+
+    def get_valid_messages(
+        self,
+        view: View,
+        message_type: MessageType,
+        is_valid: Callable[[IbftMessage], bool],
+    ) -> list[IbftMessage]:
+        """Fetch messages passing ``is_valid``; prune the ones that fail.
+
+        Mirrors the reference's GetValidMessages
+        (messages/messages.go:169-199): invalid messages are removed from the
+        store so they are never re-validated (and a Byzantine sender's slot
+        frees up only for its own future messages).
+        """
+        with self._locks[message_type]:
+            sender_map = self._maps[message_type].get(view.height, {}).get(view.round)
+            if not sender_map:
+                return []
+
+            valid: list[IbftMessage] = []
+            invalid_senders: list[bytes] = []
+            for sender, message in sender_map.items():
+                if is_valid(message):
+                    valid.append(message)
+                else:
+                    invalid_senders.append(sender)
+
+            for sender in invalid_senders:
+                del sender_map[sender]
+
+            return valid
+
+    def remove_messages(
+        self, view: View, message_type: MessageType, senders: Iterable[bytes]
+    ) -> None:
+        """Prune specific senders' messages for a view.
+
+        Batch-verification support: the engine fetches a whole view's messages
+        with a trivial filter, verifies them in one device batch, then prunes
+        the failures here — observationally equivalent to the reference's
+        per-message ``isValid`` pruning inside GetValidMessages.
+        """
+        with self._locks[message_type]:
+            sender_map = self._maps[message_type].get(view.height, {}).get(view.round)
+            if not sender_map:
+                return
+            for sender in senders:
+                sender_map.pop(sender, None)
+
+    def get_extended_rcc(
+        self,
+        height: int,
+        is_valid_message: Callable[[IbftMessage], bool],
+        is_valid_rcc: Callable[[int, list[IbftMessage]], bool],
+    ) -> list[IbftMessage]:
+        """Best (highest-round) valid round-change certificate for a height.
+
+        Mirrors GetExtendedRCC (reference messages/messages.go:202-245).  The
+        reference iterates the round map in Go's random order with a
+        ``round <= highestRound`` skip; the fixed point of that loop is "the
+        highest round whose valid-message set passes ``is_valid_rcc``, rounds
+        processed ascending" — and round 0 can never win (highestRound starts
+        at 0).  We iterate rounds in ascending order, which lands on the same
+        result deterministically.
+        """
+        message_type = MessageType.ROUND_CHANGE
+        with self._locks[message_type]:
+            round_map = self._maps[message_type].get(height, {})
+
+            # Descending with early exit: only the highest valid round can
+            # win, so dominated rounds never pay the (signature-heavy)
+            # is_valid_message predicate.
+            for round_ in sorted(round_map, reverse=True):
+                if round_ <= 0:
+                    continue
+                valid = [m for m in round_map[round_].values() if is_valid_message(m)]
+                if is_valid_rcc(round_, valid):
+                    return valid
+
+            return []
+
+    def get_most_round_change_messages(
+        self, min_round: int, height: int
+    ) -> list[IbftMessage]:
+        """Largest round-change message set at or above ``min_round``.
+
+        Mirrors GetMostRoundChangeMessages (reference
+        messages/messages.go:249-286), including the quirk that round 0 can
+        never be selected (``bestRound == 0`` means "not found").  Ties keep
+        the first (lowest) qualifying round, which is deterministic here
+        unlike Go's random map order.
+        """
+        message_type = MessageType.ROUND_CHANGE
+        with self._locks[message_type]:
+            round_map = self._maps[message_type].get(height, {})
+
+            best_round = 0
+            best_count = 0
+            for round_ in sorted(round_map):
+                if round_ < min_round:
+                    continue
+                size = len(round_map[round_])
+                if size > best_count:
+                    best_round = round_
+                    best_count = size
+
+            if best_round == 0:
+                return []
+
+            return list(round_map[best_round].values())
+
+    # -- batch-verification support ----------------------------------------
+
+    def snapshot_view(
+        self, view: View, message_type: MessageType
+    ) -> list[IbftMessage]:
+        """Contiguous snapshot of a (view, type) cell for batched verification.
+
+        Unlike ``get_valid_messages`` this does not run predicates or prune;
+        it exists so the batch verifier can pack (sender, digest, signature)
+        arrays in one pass and hand back a boolean mask.
+        """
+        with self._locks[message_type]:
+            sender_map = self._maps[message_type].get(view.height, {}).get(view.round)
+            return list(sender_map.values()) if sender_map else []

@@ -1,0 +1,55 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+so multi-chip sharding tests (tp/dp/sp over a Mesh) run without TPU hardware.
+Mirrors the reference's CI posture of running the full conformance suite on
+plain CPU runners (.github/workflows/main.yml).
+"""
+
+import os
+
+# Must happen before any `import jax` in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests on a fresh event loop (no plugin dependency).
+
+    After the test body finishes, asserts no asyncio tasks are left running —
+    the analogue of the reference's goleak wrapper (core/core_test.go:9-11,
+    messages/messages_test.go:59-61).  The check runs *inside* the loop,
+    before asyncio.run's implicit cancel-and-close masks leaks.
+    """
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+
+        async def _run_and_check_leaks():
+            await func(**kwargs)
+            await asyncio.sleep(0)  # let just-finished tasks settle
+            leaked = [
+                t
+                for t in asyncio.all_tasks()
+                if t is not asyncio.current_task() and not t.done()
+            ]
+            assert not leaked, f"leaked asyncio tasks: {leaked}"
+
+        asyncio.run(_run_and_check_leaks())
+        return True
+    return None
+
+

@@ -1,0 +1,133 @@
+"""Event manager / subscription tests, porting the reference's
+messages/event_manager_test.go and event_subscription_test.go scenarios."""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.messages import (
+    EventManager,
+    MessageStore,
+    MessageType,
+    SubscriptionDetails,
+    View,
+)
+from go_ibft_tpu.messages.events import Subscription
+
+
+def _details(mtype=MessageType.PREPARE, height=0, round_=0, has_min_round=False):
+    return SubscriptionDetails(
+        message_type=mtype,
+        view=View(height=height, round=round_),
+        has_min_round=has_min_round,
+    )
+
+
+# -- event_supported matrix (reference event_subscription_test.go:11-151) ----
+
+
+@pytest.mark.parametrize(
+    "sub_round,has_min_round,event_round,expected",
+    [
+        (0, False, 0, True),  # exact match
+        (0, False, 1, False),  # exact mode: higher round rejected
+        (1, False, 0, False),  # exact mode: lower round rejected
+        (1, True, 1, True),  # min-round: equal accepted
+        (1, True, 5, True),  # min-round: higher accepted
+        (2, True, 1, False),  # min-round: lower rejected
+    ],
+)
+def test_event_supported_round_matching(sub_round, has_min_round, event_round, expected):
+    sub = Subscription(
+        id=1, details=_details(round_=sub_round, has_min_round=has_min_round)
+    )
+    assert (
+        sub._event_supported(MessageType.PREPARE, View(height=0, round=event_round))
+        is expected
+    )
+
+
+def test_event_supported_height_and_type_must_match():
+    sub = Subscription(id=1, details=_details(height=3))
+    assert not sub._event_supported(MessageType.PREPARE, View(height=4, round=0))
+    assert not sub._event_supported(MessageType.COMMIT, View(height=3, round=0))
+    assert sub._event_supported(MessageType.PREPARE, View(height=3, round=0))
+
+
+# -- manager behavior (reference event_manager_test.go) ----------------------
+
+
+async def test_subscribe_and_signal():
+    em = EventManager()
+    sub = em.subscribe(_details(height=1, round_=2))
+    assert em.num_subscriptions == 1
+
+    em.signal_event(MessageType.PREPARE, View(height=1, round=2))
+    assert await asyncio.wait_for(sub.wait(), 1) == 2
+    em.close()
+
+
+async def test_cancel_subscription_wakes_waiter():
+    em = EventManager()
+    sub = em.subscribe(_details())
+    waiter = asyncio.create_task(sub.wait())
+    await asyncio.sleep(0)
+    em.cancel_subscription(sub.id)
+    assert em.num_subscriptions == 0
+    assert await asyncio.wait_for(waiter, 1) is None
+
+
+async def test_cancel_unknown_id_noop():
+    em = EventManager()
+    em.subscribe(_details())
+    em.cancel_subscription(999)
+    assert em.num_subscriptions == 1
+    em.close()
+
+
+async def test_close_wakes_all():
+    em = EventManager()
+    subs = [em.subscribe(_details()) for _ in range(3)]
+    waiters = [asyncio.create_task(s.wait()) for s in subs]
+    await asyncio.sleep(0)
+    em.close()
+    assert await asyncio.wait_for(asyncio.gather(*waiters), 1) == [None, None, None]
+    assert em.num_subscriptions == 0
+
+
+async def test_non_matching_event_not_delivered():
+    em = EventManager()
+    sub = em.subscribe(_details(height=1))
+    em.signal_event(MessageType.PREPARE, View(height=9, round=0))
+    waiter = asyncio.create_task(sub.wait())
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    em.close()
+    assert await asyncio.wait_for(waiter, 1) is None
+
+
+async def test_notifications_coalesce_not_block():
+    # The reference pushes non-blocking into a buffered channel and drops
+    # extras (event_subscription.go:72-84); we coalesce the same way.
+    em = EventManager()
+    sub = em.subscribe(_details(has_min_round=True))
+    for round_ in range(50):
+        em.signal_event(MessageType.PREPARE, View(height=0, round=round_))
+    # The subscriber wakes and re-checks state; it must see *a* recent round.
+    got = await asyncio.wait_for(sub.wait(), 1)
+    assert got >= 0
+    em.close()
+
+
+async def test_store_signal_event_roundtrip():
+    # reference messages_test.go:377 TestMessages_EventManager
+    store = MessageStore()
+    sub = store.subscribe(
+        SubscriptionDetails(
+            message_type=MessageType.COMMIT, view=View(height=2, round=1)
+        )
+    )
+    store.signal_event(MessageType.COMMIT, View(height=2, round=1))
+    assert await asyncio.wait_for(sub.wait(), 1) == 1
+    store.unsubscribe(sub.id)
+    store.close()

@@ -1,0 +1,210 @@
+"""Extractor / certificate-validator tests, porting the tables of the
+reference's messages/helpers_test.go (808 LoC of extractor & PC-validator
+cases)."""
+
+import pytest
+
+from go_ibft_tpu.messages import (
+    CommitMessage,
+    CommittedSeal,
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    PrepareMessage,
+    PrePrepareMessage,
+    Proposal,
+    RoundChangeCertificate,
+    RoundChangeMessage,
+    View,
+    WrongCommitMessageTypeError,
+    are_valid_pc_messages,
+    extract_commit_hash,
+    extract_committed_seal,
+    extract_committed_seals,
+    extract_last_prepared_proposal,
+    extract_latest_pc,
+    extract_prepare_hash,
+    extract_proposal,
+    extract_proposal_hash,
+    extract_round_change_certificate,
+    has_unique_senders,
+)
+
+
+def _commit(sender=b"c", hash_=b"h", seal=b"s", height=0, round_=0):
+    return IbftMessage(
+        view=View(height=height, round=round_),
+        sender=sender,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(proposal_hash=hash_, committed_seal=seal),
+    )
+
+
+def _prepare(sender=b"p", hash_=b"h", height=0, round_=0):
+    return IbftMessage(
+        view=View(height=height, round=round_),
+        sender=sender,
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=hash_),
+    )
+
+
+def _preprepare(sender=b"pp", hash_=b"h", raw=b"block", height=0, round_=0, cert=None):
+    return IbftMessage(
+        view=View(height=height, round=round_),
+        sender=sender,
+        type=MessageType.PREPREPARE,
+        preprepare_data=PrePrepareMessage(
+            proposal=Proposal(raw_proposal=raw, round=round_),
+            proposal_hash=hash_,
+            certificate=cert,
+        ),
+    )
+
+
+# -- extractors (reference helpers_test.go:13-411) ---------------------------
+
+
+def test_extract_committed_seals():
+    msgs = [_commit(sender=b"a", seal=b"s1"), _commit(sender=b"b", seal=b"s2")]
+    seals = extract_committed_seals(msgs)
+    assert seals == [
+        CommittedSeal(signer=b"a", signature=b"s1"),
+        CommittedSeal(signer=b"b", signature=b"s2"),
+    ]
+
+
+def test_extract_committed_seals_wrong_type_raises():
+    with pytest.raises(WrongCommitMessageTypeError):
+        extract_committed_seals([_commit(), _prepare()])
+
+
+def test_extract_committed_seal_missing_payload():
+    msg = IbftMessage(type=MessageType.COMMIT)
+    assert extract_committed_seal(msg) is None
+
+
+def test_extract_commit_hash():
+    assert extract_commit_hash(_commit(hash_=b"H")) == b"H"
+    assert extract_commit_hash(_prepare()) is None
+    assert extract_commit_hash(IbftMessage(type=MessageType.COMMIT)) is None
+
+
+def test_extract_proposal():
+    assert extract_proposal(_preprepare(raw=b"B")).raw_proposal == b"B"
+    assert extract_proposal(_commit()) is None
+    assert extract_proposal(IbftMessage(type=MessageType.PREPREPARE)) is None
+
+
+def test_extract_proposal_hash():
+    assert extract_proposal_hash(_preprepare(hash_=b"H")) == b"H"
+    assert extract_proposal_hash(_commit()) is None
+
+
+def test_extract_rcc():
+    cert = RoundChangeCertificate(round_change_messages=[])
+    assert extract_round_change_certificate(_preprepare(cert=cert)) == cert
+    assert extract_round_change_certificate(_commit()) is None
+
+
+def test_extract_prepare_hash():
+    assert extract_prepare_hash(_prepare(hash_=b"H")) == b"H"
+    assert extract_prepare_hash(_commit()) is None
+
+
+def _round_change(sender=b"r", height=0, round_=0, pc=None, proposal=None):
+    return IbftMessage(
+        view=View(height=height, round=round_),
+        sender=sender,
+        type=MessageType.ROUND_CHANGE,
+        round_change_data=RoundChangeMessage(
+            last_prepared_proposal=proposal, latest_prepared_certificate=pc
+        ),
+    )
+
+
+def test_extract_latest_pc():
+    pc = PreparedCertificate(proposal_message=_preprepare(), prepare_messages=[])
+    assert extract_latest_pc(_round_change(pc=pc)) == pc
+    assert extract_latest_pc(_commit()) is None
+    assert extract_latest_pc(IbftMessage(type=MessageType.ROUND_CHANGE)) is None
+
+
+def test_extract_last_prepared_proposal():
+    prop = Proposal(raw_proposal=b"B", round=1)
+    assert extract_last_prepared_proposal(_round_change(proposal=prop)) == prop
+    assert extract_last_prepared_proposal(_commit()) is None
+
+
+# -- HasUniqueSenders (reference helpers_test.go:413-465) --------------------
+
+
+def test_has_unique_senders():
+    assert not has_unique_senders([])
+    assert has_unique_senders([_commit(sender=b"a")])
+    assert has_unique_senders([_commit(sender=b"a"), _commit(sender=b"b")])
+    assert not has_unique_senders([_commit(sender=b"a"), _commit(sender=b"a")])
+
+
+# -- AreValidPCMessages (reference helpers_test.go:467-808) ------------------
+
+
+def _pc_set(height=1, round_=1, hash_=b"h"):
+    return [
+        _preprepare(sender=b"proposer", hash_=hash_, height=height, round_=round_),
+        _prepare(sender=b"p1", hash_=hash_, height=height, round_=round_),
+        _prepare(sender=b"p2", hash_=hash_, height=height, round_=round_),
+    ]
+
+
+def test_valid_pc_messages_happy():
+    assert are_valid_pc_messages(_pc_set(), height=1, round_limit=2)
+
+
+def test_pc_messages_empty_set():
+    assert not are_valid_pc_messages([], height=1, round_limit=2)
+
+
+def test_pc_messages_height_mismatch():
+    # reference helpers_test.go:712 TestMessages_AllHaveSameHeight
+    msgs = _pc_set(height=1)
+    msgs[1].view.height = 2
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+
+
+def test_pc_messages_round_mismatch():
+    msgs = _pc_set(round_=1)
+    msgs[2].view.round = 0
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+
+
+def test_pc_messages_round_limit():
+    # reference helpers_test.go:575 TestMessages_AllHaveLowerRound
+    msgs = _pc_set(round_=2)
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+    assert are_valid_pc_messages(msgs, height=1, round_limit=3)
+
+
+def test_pc_messages_hash_mismatch():
+    # reference helpers_test.go:467 TestMessages_HaveSameProposalHash
+    msgs = _pc_set(hash_=b"h")
+    msgs[1].prepare_data.proposal_hash = b"different"
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+
+
+def test_pc_messages_bad_member_type():
+    msgs = _pc_set()
+    msgs.append(_commit(sender=b"x", height=1, round_=1))
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+
+
+def test_pc_messages_duplicate_sender():
+    msgs = _pc_set()
+    msgs.append(_prepare(sender=b"p1", hash_=b"h", height=1, round_=1))
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)
+
+
+def test_pc_messages_missing_view():
+    msgs = _pc_set()
+    msgs[0].view = None
+    assert not are_valid_pc_messages(msgs, height=1, round_limit=2)

@@ -1,0 +1,180 @@
+"""Message store tests, porting the scenarios of the reference's
+messages/messages_test.go (add, dedup by sender, prune, validity-filtered
+fetch with pruning, extended RCC, most-RC) plus batch-drain support."""
+
+from go_ibft_tpu.messages import (
+    IbftMessage,
+    MessageStore,
+    MessageType,
+    PrepareMessage,
+    RoundChangeMessage,
+    View,
+)
+
+
+def _msg(mtype, height, round_, sender, **payload):
+    kwargs = {}
+    if mtype == MessageType.PREPARE:
+        kwargs["prepare_data"] = PrepareMessage(**payload) if payload else PrepareMessage()
+    elif mtype == MessageType.ROUND_CHANGE:
+        kwargs["round_change_data"] = RoundChangeMessage()
+    return IbftMessage(
+        view=View(height=height, round=round_), sender=sender, type=mtype, **kwargs
+    )
+
+
+def test_add_message_all_types():
+    # reference messages_test.go:65 TestMessages_AddMessage
+    store = MessageStore()
+    view = View(height=1, round=0)
+    for mtype in MessageType:
+        for sender in (b"a", b"b", b"c"):
+            store.add_message(_msg(mtype, 1, 0, sender))
+        assert store.num_messages(view, mtype) == 3
+    store.close()
+
+
+def test_add_duplicates_deduped_by_sender():
+    # reference messages_test.go:100 TestMessages_AddDuplicates
+    store = MessageStore()
+    view = View(height=1, round=0)
+    for _ in range(5):
+        store.add_message(_msg(MessageType.PREPARE, 1, 0, b"same-sender"))
+    assert store.num_messages(view, MessageType.PREPARE) == 1
+
+    # A later message from the same sender overwrites the earlier one.
+    updated = _msg(MessageType.PREPARE, 1, 0, b"same-sender", proposal_hash=b"new")
+    store.add_message(updated)
+    got = store.get_valid_messages(view, MessageType.PREPARE, lambda m: True)
+    assert got == [updated]
+    store.close()
+
+
+def test_prune_by_height():
+    # reference messages_test.go:131 TestMessages_Prune
+    store = MessageStore()
+    for height in (1, 2, 3):
+        for sender in (b"a", b"b"):
+            store.add_message(_msg(MessageType.COMMIT, height, 0, sender))
+    store.prune_by_height(3)
+    assert store.num_messages(View(height=1, round=0), MessageType.COMMIT) == 0
+    assert store.num_messages(View(height=2, round=0), MessageType.COMMIT) == 0
+    assert store.num_messages(View(height=3, round=0), MessageType.COMMIT) == 2
+    store.close()
+
+
+def test_get_valid_messages_prunes_invalid():
+    # reference messages_test.go:183 TestMessages_GetValidMessagesMessage
+    store = MessageStore()
+    view = View(height=1, round=0)
+    for sender in (b"a", b"bad", b"c"):
+        store.add_message(_msg(MessageType.PREPARE, 1, 0, sender))
+
+    got = store.get_valid_messages(
+        view, MessageType.PREPARE, lambda m: m.sender != b"bad"
+    )
+    assert sorted(m.sender for m in got) == [b"a", b"c"]
+    # invalid entry was pruned from the store
+    assert store.num_messages(view, MessageType.PREPARE) == 2
+    # but the sender can submit again
+    store.add_message(_msg(MessageType.PREPARE, 1, 0, b"bad"))
+    assert store.num_messages(view, MessageType.PREPARE) == 3
+    store.close()
+
+
+def test_get_extended_rcc_highest_valid_round():
+    # reference messages_test.go:273 TestMessages_GetExtendedRCC
+    store = MessageStore()
+    height = 5
+    # round 1: quorum of 4; round 2: quorum of 4; round 3: only 2 (no quorum)
+    for round_, n in [(1, 4), (2, 4), (3, 2)]:
+        for i in range(n):
+            store.add_message(
+                _msg(MessageType.ROUND_CHANGE, height, round_, b"v%d" % i)
+            )
+
+    rcc = store.get_extended_rcc(
+        height,
+        is_valid_message=lambda m: True,
+        is_valid_rcc=lambda round_, msgs: len(msgs) >= 4,
+    )
+    assert len(rcc) == 4
+    assert all(m.view.round == 2 for m in rcc)
+    store.close()
+
+
+def test_get_extended_rcc_round_zero_never_wins():
+    store = MessageStore()
+    for i in range(4):
+        store.add_message(_msg(MessageType.ROUND_CHANGE, 5, 0, b"v%d" % i))
+    rcc = store.get_extended_rcc(5, lambda m: True, lambda r, msgs: len(msgs) >= 1)
+    assert rcc == []
+    store.close()
+
+
+def test_get_extended_rcc_invalid_messages_filtered():
+    store = MessageStore()
+    for i in range(4):
+        store.add_message(_msg(MessageType.ROUND_CHANGE, 5, 1, b"v%d" % i))
+    rcc = store.get_extended_rcc(
+        5,
+        is_valid_message=lambda m: m.sender != b"v0",
+        is_valid_rcc=lambda r, msgs: len(msgs) >= 3,
+    )
+    assert len(rcc) == 3
+    assert all(m.sender != b"v0" for m in rcc)
+    store.close()
+
+
+def test_get_most_round_change_messages():
+    # reference messages_test.go:334 TestMessages_GetMostRoundChangeMessages
+    store = MessageStore()
+    height = 1
+    for round_, n in [(1, 2), (2, 5), (4, 3)]:
+        for i in range(n):
+            store.add_message(
+                _msg(MessageType.ROUND_CHANGE, height, round_, b"v%d" % i)
+            )
+
+    most = store.get_most_round_change_messages(0, height)
+    assert len(most) == 5
+    assert all(m.view.round == 2 for m in most)
+
+    # min_round excludes the biggest set
+    most = store.get_most_round_change_messages(3, height)
+    assert len(most) == 3
+    assert all(m.view.round == 4 for m in most)
+
+    # nothing at/above min_round
+    assert store.get_most_round_change_messages(5, height) == []
+    store.close()
+
+
+def test_get_most_round_change_round_zero_not_found():
+    store = MessageStore()
+    for i in range(9):
+        store.add_message(_msg(MessageType.ROUND_CHANGE, 1, 0, b"v%d" % i))
+    # the reference treats bestRound == 0 as "not found" (messages.go:275-278)
+    assert store.get_most_round_change_messages(0, 1) == []
+    store.close()
+
+
+def test_remove_messages_batch_prune():
+    store = MessageStore()
+    view = View(height=1, round=0)
+    for sender in (b"a", b"b", b"c", b"d"):
+        store.add_message(_msg(MessageType.COMMIT, 1, 0, sender))
+    store.remove_messages(view, MessageType.COMMIT, [b"b", b"d", b"ghost"])
+    left = store.snapshot_view(view, MessageType.COMMIT)
+    assert sorted(m.sender for m in left) == [b"a", b"c"]
+    store.close()
+
+
+def test_snapshot_view_does_not_prune():
+    store = MessageStore()
+    view = View(height=1, round=0)
+    store.add_message(_msg(MessageType.COMMIT, 1, 0, b"a"))
+    snap = store.snapshot_view(view, MessageType.COMMIT)
+    assert len(snap) == 1
+    assert store.num_messages(view, MessageType.COMMIT) == 1
+    store.close()

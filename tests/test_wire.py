@@ -1,0 +1,158 @@
+"""Wire-codec tests: byte-level vectors + round-trips.
+
+The byte-level vectors are hand-derived from the protobuf wire format so that
+``payload_no_sig`` stays byte-compatible with the Go reference's
+``proto.Marshal`` output (reference messages/proto/helper.go:13-27).
+"""
+
+import pytest
+
+from go_ibft_tpu.messages import (
+    CommitMessage,
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    PrepareMessage,
+    PrePrepareMessage,
+    Proposal,
+    RoundChangeCertificate,
+    RoundChangeMessage,
+    View,
+)
+
+
+def test_view_encoding_bytes():
+    assert View(height=1, round=2).encode() == b"\x08\x01\x10\x02"
+    # proto3 zero values are omitted entirely
+    assert View(height=0, round=0).encode() == b""
+    # multi-byte varint: 300 = 0xAC 0x02
+    assert View(height=300, round=0).encode() == b"\x08\xac\x02"
+
+
+def test_proposal_encoding_bytes():
+    assert Proposal(raw_proposal=b"ab", round=3).encode() == b"\x0a\x02ab\x10\x03"
+    assert Proposal().encode() == b""
+
+
+def test_ibft_message_encoding_bytes():
+    msg = IbftMessage(
+        view=View(height=1, round=2),
+        sender=b"\x01",
+        signature=b"\xff",
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(proposal_hash=b"h", committed_seal=b"s"),
+    )
+    expected = (
+        b"\x0a\x04\x08\x01\x10\x02"  # view
+        b"\x12\x01\x01"  # from
+        b"\x1a\x01\xff"  # signature
+        b"\x20\x02"  # type = COMMIT
+        b"\x3a\x06\x0a\x01h\x12\x01s"  # commit payload
+    )
+    assert msg.encode() == expected
+    # payload_no_sig drops exactly the signature field
+    assert msg.payload_no_sig() == (
+        b"\x0a\x04\x08\x01\x10\x02" b"\x12\x01\x01" b"\x20\x02" b"\x3a\x06\x0a\x01h\x12\x01s"
+    )
+    # and does not mutate the message
+    assert msg.signature == b"\xff"
+
+
+def test_preprepare_type_zero_omitted():
+    # type = PREPREPARE = 0 is a proto3 default: omitted on the wire
+    msg = IbftMessage(
+        view=View(height=5, round=0),
+        sender=b"A",
+        type=MessageType.PREPREPARE,
+        preprepare_data=PrePrepareMessage(
+            proposal=Proposal(raw_proposal=b"block", round=0),
+            proposal_hash=b"H",
+        ),
+    )
+    raw = msg.encode()
+    assert b"\x20" not in raw[:8]  # no type tag
+    decoded = IbftMessage.decode(raw)
+    assert decoded.type == MessageType.PREPREPARE
+    assert decoded.preprepare_data.proposal.raw_proposal == b"block"
+
+
+def test_set_but_empty_nested_message_is_encoded():
+    # Go pointer semantics: a set-but-empty message must be distinguishable
+    # from an unset one.
+    msg = PrePrepareMessage(proposal=Proposal(), proposal_hash=b"")
+    assert msg.encode() == b"\x0a\x00"
+    decoded = PrePrepareMessage.decode(msg.encode())
+    assert decoded.proposal is not None
+    assert decoded.certificate is None
+
+
+def _rich_message() -> IbftMessage:
+    prepare = IbftMessage(
+        view=View(height=7, round=1),
+        sender=b"validator-2",
+        signature=b"sig-p",
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=b"hash-7"),
+    )
+    proposal_msg = IbftMessage(
+        view=View(height=7, round=1),
+        sender=b"validator-1",
+        signature=b"sig-pp",
+        type=MessageType.PREPREPARE,
+        preprepare_data=PrePrepareMessage(
+            proposal=Proposal(raw_proposal=b"raw-block", round=1),
+            proposal_hash=b"hash-7",
+            certificate=RoundChangeCertificate(round_change_messages=[]),
+        ),
+    )
+    return IbftMessage(
+        view=View(height=7, round=2),
+        sender=b"validator-3",
+        signature=b"sig-rc",
+        type=MessageType.ROUND_CHANGE,
+        round_change_data=RoundChangeMessage(
+            last_prepared_proposal=Proposal(raw_proposal=b"raw-block", round=1),
+            latest_prepared_certificate=PreparedCertificate(
+                proposal_message=proposal_msg,
+                prepare_messages=[prepare, prepare],
+            ),
+        ),
+    )
+
+
+def test_roundtrip_nested():
+    msg = _rich_message()
+    assert IbftMessage.decode(msg.encode()) == msg
+
+
+def test_roundtrip_all_types():
+    cases = [
+        IbftMessage(type=MessageType.PREPARE, prepare_data=PrepareMessage(b"h")),
+        IbftMessage(type=MessageType.COMMIT, commit_data=CommitMessage(b"h", b"s")),
+        IbftMessage(
+            type=MessageType.ROUND_CHANGE, round_change_data=RoundChangeMessage()
+        ),
+        IbftMessage(
+            type=MessageType.PREPREPARE, preprepare_data=PrePrepareMessage()
+        ),
+    ]
+    for msg in cases:
+        assert IbftMessage.decode(msg.encode()) == msg
+
+
+def test_decode_skips_unknown_fields():
+    # field 15 varint (tag 0x78), value 1 — must be skipped
+    raw = b"\x78\x01" + View(height=9).encode()
+    assert View.decode(raw) == View(height=9)
+
+
+def test_truncated_raises():
+    msg = _rich_message().encode()
+    with pytest.raises(ValueError):
+        IbftMessage.decode(msg[:-1])
+
+
+@pytest.mark.parametrize("value", [0, 1, 127, 128, 300, 2**32, 2**63, 2**64 - 1])
+def test_varint_extremes(value):
+    v = View(height=value, round=0)
+    assert View.decode(v.encode()).height == value

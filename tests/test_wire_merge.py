@@ -1,0 +1,72 @@
+"""proto3 merge-semantics regression tests (code-review findings).
+
+Foreign bytes must parse exactly as a protobuf implementation would:
+duplicated singular message fields merge, switching oneof members clears the
+previous one, unknown enum values survive, truncation always raises.
+"""
+
+import pytest
+
+from go_ibft_tpu.messages import (
+    IbftMessage,
+    MessageType,
+    PrepareMessage,
+    PrePrepareMessage,
+    View,
+)
+
+
+def _field(fnum, payload: bytes) -> bytes:
+    return bytes([(fnum << 3) | 2, len(payload)]) + payload
+
+
+def test_duplicate_view_fields_merge():
+    # view{height=7} followed by view{round=9} must merge to (7, 9)
+    raw = _field(1, View(height=7).encode()) + _field(1, View(round=9).encode())
+    msg = IbftMessage.decode(raw)
+    assert msg.view == View(height=7, round=9)
+
+
+def test_oneof_switch_clears_previous_member():
+    # prepareData then preprepareData: only the later member survives
+    raw = _field(6, PrepareMessage(proposal_hash=b"XXXX").encode()) + _field(
+        5, PrePrepareMessage(proposal_hash=b"YYYY").encode()
+    )
+    msg = IbftMessage.decode(raw)
+    assert msg.prepare_data is None
+    assert msg.preprepare_data is not None
+    assert msg.preprepare_data.proposal_hash == b"YYYY"
+    # re-encoding emits exactly one payload member
+    assert msg.encode() == _field(5, PrePrepareMessage(proposal_hash=b"YYYY").encode())
+
+
+def test_oneof_same_member_merges():
+    raw = _field(5, _field(1, b"")) + _field(  # preprepare with empty proposal
+        5, _field(2, b"HH")  # preprepare with hash only
+    )
+    msg = IbftMessage.decode(raw)
+    assert msg.preprepare_data.proposal is not None
+    assert msg.preprepare_data.proposal_hash == b"HH"
+
+
+def test_duplicate_scalar_last_wins():
+    raw = b"\x08\x01\x08\x05"  # height=1 then height=5
+    assert View.decode(raw).height == 5
+
+
+def test_unknown_enum_value_preserved():
+    raw = b"\x20\x09"  # type = 9 (unknown)
+    msg = IbftMessage.decode(raw)
+    assert msg.type == 9
+    assert not isinstance(msg.type, MessageType)
+    # round-trips unchanged
+    assert IbftMessage.decode(msg.encode()).type == 9
+
+
+def test_truncated_fixed_width_fields_raise():
+    # field 9 with wire type 5 (fixed32) but only 2 payload bytes
+    with pytest.raises(ValueError, match="truncated fixed32"):
+        View.decode(b"\x4d\x01\x02")
+    # field 9 with wire type 1 (fixed64) but only 3 payload bytes
+    with pytest.raises(ValueError, match="truncated fixed64"):
+        View.decode(b"\x49\x01\x02\x03")

@@ -1,0 +1,993 @@
+"""The IBFT 2.0 consensus engine.
+
+Re-design of the reference's state machine (core/ibft.go:59-1315) on asyncio.
+One :class:`IBFT` instance drives one validator; a cluster of instances
+multicasting to each other reaches agreement on one proposal per *height*,
+possibly across multiple *rounds* with rotating proposer and exponentially
+growing timeouts.
+
+Control flow stays on host (it is branchy and latency-bound); the O(N)
+per-phase data plane — signature and seal verification — is delegated to a
+:class:`~go_ibft_tpu.core.backend.BatchVerifier` when the backend provides
+one, draining each phase's message store in one device batch (SURVEY.md §7).
+
+Concurrency model (mirrors reference core/ibft.go:323-394 exactly):
+every round spawns four workers — round timer, future-proposal watcher,
+round-change-certificate watcher, and the state machine — whose first
+completed signal wins the round arbitration; teardown cancels and awaits all
+workers (the reference's WaitGroup barrier) before the next round starts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Sequence
+
+from ..messages import helpers
+from ..messages.events import SubscriptionDetails
+from ..messages.helpers import CommittedSeal
+from ..messages.store import MessageStore
+from ..messages.wire import (
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    Proposal,
+    RoundChangeCertificate,
+    View,
+)
+from ..utils.metrics import set_gauge
+from .backend import Backend, BatchVerifier
+from .state import SequenceState, StateName
+from .transport import Transport
+from .validator_manager import Logger, ValidatorManager, senders_of
+
+# Default base round (round 0) timeout, seconds (reference core/ibft.go:49-50).
+DEFAULT_BASE_ROUND_TIMEOUT = 10.0
+
+_ROUND_FACTOR_BASE = 2.0
+
+
+def get_round_timeout(
+    base_round_timeout: float, additional_timeout: float, round_: int
+) -> float:
+    """Exponential round timeout: base·2^round + additional
+    (reference core/ibft.go:1300-1315)."""
+    return base_round_timeout * (_ROUND_FACTOR_BASE**round_) + additional_timeout
+
+
+class _NewProposalEvent:
+    """A valid proposal for a higher round (reference core/ibft.go:195-198)."""
+
+    __slots__ = ("proposal_message", "round")
+
+    def __init__(self, proposal_message: IbftMessage, round_: int) -> None:
+        self.proposal_message = proposal_message
+        self.round = round_
+
+
+class _RoundSignals:
+    """Per-round-iteration signal slots.
+
+    The reference uses unbuffered channels selected against ctx.Done
+    (core/ibft.go:77-94,170-207); futures owned by a single round iteration
+    give the same no-stale-events guarantee — they are dropped wholesale at
+    teardown.
+    """
+
+    def __init__(self) -> None:
+        self.new_proposal: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.round_certificate: asyncio.Future = (
+            asyncio.get_running_loop().create_future()
+        )
+        self.round_expired: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.round_done: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    def all(self) -> list[asyncio.Future]:
+        return [
+            self.new_proposal,
+            self.round_certificate,
+            self.round_expired,
+            self.round_done,
+        ]
+
+    @staticmethod
+    def fire(fut: asyncio.Future, value=None) -> None:
+        if not fut.done():
+            fut.set_result(value)
+
+
+class IBFT:
+    """A single IBFT consensus state machine instance (reference core/ibft.go:59-136)."""
+
+    def __init__(
+        self,
+        logger: Logger,
+        backend: Backend,
+        transport: Transport,
+        *,
+        message_store: Optional[MessageStore] = None,
+        batch_verifier: Optional[BatchVerifier] = None,
+    ) -> None:
+        self.log = logger
+        self.backend = backend
+        self.transport = transport
+        self.messages = message_store if message_store is not None else MessageStore()
+        self.state = SequenceState()
+        self.validator_manager = ValidatorManager(backend, logger)
+        self.base_round_timeout = DEFAULT_BASE_ROUND_TIMEOUT
+        self.additional_timeout = 0.0
+        # Explicit batch verifier wins; otherwise use the backend when it
+        # implements the BatchVerifier protocol.
+        if batch_verifier is not None:
+            self.batch_verifier: Optional[BatchVerifier] = batch_verifier
+        elif isinstance(backend, BatchVerifier):
+            self.batch_verifier = backend
+        else:
+            self.batch_verifier = None
+        self._signals: Optional[_RoundSignals] = None
+
+    # -- configuration (reference core/ibft.go:1151-1159) -------------------
+
+    def extend_round_timeout(self, amount: float) -> None:
+        """Extend each round's timer by ``amount`` seconds."""
+        self.additional_timeout = amount
+
+    def set_base_round_timeout(self, base: float) -> None:
+        """Set the base (round 0) timeout in seconds."""
+        self.base_round_timeout = base
+
+    # ------------------------------------------------------------------
+    # sequence driver (reference core/ibft.go:304-395)
+    # ------------------------------------------------------------------
+
+    async def run_sequence(self, height: int) -> None:
+        """Run the IBFT sequence for ``height`` until a proposal is finalized.
+
+        Cancel the surrounding task to abort; the backend's
+        ``sequence_cancelled`` callback fires and CancelledError propagates.
+        """
+        start_time = time.monotonic()
+
+        self.state.reset(height)
+
+        try:
+            self.validator_manager.init(height)
+        except Exception as err:  # noqa: BLE001 - parity: the reference logs
+            # and aborts on any init failure (ibft.go:310-314)
+            self.log.error(
+                "failed to run sequence - validator manager init",
+                height,
+                err,
+            )
+            return
+
+        self.messages.prune_by_height(height)
+
+        self.log.info("sequence started", height)
+        try:
+            while True:
+                view = self.state.view
+
+                try:
+                    self.backend.round_starts(view)
+                except Exception as err:  # noqa: BLE001 - callback is advisory
+                    self.log.error(
+                        "failed to handle start round callback on backend", view, err
+                    )
+
+                self.log.info("round started", view.round)
+
+                current_round = view.round
+                signals = _RoundSignals()
+                self._signals = signals
+                workers = [
+                    asyncio.create_task(
+                        self._start_round_timer(signals, current_round),
+                        name=f"ibft-timer-h{height}-r{current_round}",
+                    ),
+                    asyncio.create_task(
+                        self._watch_for_future_proposal(signals),
+                        name=f"ibft-future-proposal-h{height}-r{current_round}",
+                    ),
+                    asyncio.create_task(
+                        self._watch_for_round_change_certificates(signals),
+                        name=f"ibft-rcc-watch-h{height}-r{current_round}",
+                    ),
+                    asyncio.create_task(
+                        self._start_round(signals),
+                        name=f"ibft-round-h{height}-r{current_round}",
+                    ),
+                ]
+
+                async def teardown() -> None:
+                    # The reference's cancelRound(); wg.Wait() barrier
+                    # (core/ibft.go:349-352): all workers exit before the
+                    # next round may start.
+                    for task in workers:
+                        task.cancel()
+                    results = await asyncio.gather(*workers, return_exceptions=True)
+                    for task, result in zip(workers, results):
+                        if isinstance(result, Exception) and not isinstance(
+                            result, asyncio.CancelledError
+                        ):
+                            self.log.error(
+                                "round worker crashed", task.get_name(), result
+                            )
+
+                try:
+                    await asyncio.wait(
+                        signals.all(), return_when=asyncio.FIRST_COMPLETED
+                    )
+                except asyncio.CancelledError:
+                    # ctx cancelled by the embedder (core/ibft.go:383-392)
+                    await teardown()
+                    try:
+                        self.backend.sequence_cancelled(view)
+                    except Exception as err:  # noqa: BLE001
+                        self.log.error(
+                            "failed to handle sequence cancelled callback", view, err
+                        )
+                    self.log.debug("sequence cancelled")
+                    raise
+
+                if signals.new_proposal.done():
+                    ev: _NewProposalEvent = signals.new_proposal.result()
+                    await teardown()
+                    self.log.info("received future proposal", ev.round)
+                    self._move_to_new_round(ev.round)
+                    self._accept_proposal(ev.proposal_message)
+                    self.state.set_round_started(True)
+                    # NOTE: the reference multicasts this PREPARE with the
+                    # view captured at round start, not ev.round
+                    # (core/ibft.go:355-362); mirrored bit-for-bit.
+                    self._send_prepare_message(view)
+                elif signals.round_certificate.done():
+                    round_ = signals.round_certificate.result()
+                    await teardown()
+                    self.log.info("received future RCC", round_)
+                    self._move_to_new_round(round_)
+                elif signals.round_expired.done():
+                    await teardown()
+                    self.log.info("round timeout expired", current_round)
+                    new_round = current_round + 1
+                    self._move_to_new_round(new_round)
+                    self._send_round_change_message(height, new_round)
+                elif signals.round_done.done():
+                    # Consensus for this height is finished (ibft.go:376-382).
+                    await teardown()
+                    self._insert_block()
+                    return
+        finally:
+            self._signals = None
+            set_gauge(("go-ibft", "sequence", "duration"), time.monotonic() - start_time)
+            self.log.info("sequence done", height)
+
+    # -- round workers ------------------------------------------------------
+
+    async def _start_round_timer(self, signals: _RoundSignals, round_: int) -> None:
+        """Exponential round timer worker (reference core/ibft.go:145-165)."""
+        start_time = time.monotonic()
+        timeout = get_round_timeout(
+            self.base_round_timeout, self.additional_timeout, round_
+        )
+        try:
+            await asyncio.sleep(timeout)
+            signals.fire(signals.round_expired)
+        finally:
+            set_gauge(("go-ibft", "round", "duration"), time.monotonic() - start_time)
+
+    async def _watch_for_future_proposal(self, signals: _RoundSignals) -> None:
+        """Jump rounds on valid proposals for higher rounds
+        (reference core/ibft.go:211-253)."""
+        view = self.state.view
+        height, next_round = view.height, view.round + 1
+
+        sub = self._subscribe(
+            SubscriptionDetails(
+                message_type=MessageType.PREPREPARE,
+                view=View(height=height, round=next_round),
+                has_min_round=True,
+            )
+        )
+        try:
+            while True:
+                round_ = await sub.wait()
+                if round_ is None:
+                    return
+                proposal = self._handle_preprepare(View(height=height, round=round_))
+                if proposal is None:
+                    continue
+                signals.fire(
+                    signals.new_proposal, _NewProposalEvent(proposal, round_)
+                )
+                return
+        finally:
+            self.messages.unsubscribe(sub.id)
+
+    async def _watch_for_round_change_certificates(
+        self, signals: _RoundSignals
+    ) -> None:
+        """Jump rounds on valid RCCs for higher rounds
+        (reference core/ibft.go:258-301)."""
+        view = self.state.view
+        height, round_ = view.height, view.round
+
+        sub = self._subscribe(
+            SubscriptionDetails(
+                message_type=MessageType.ROUND_CHANGE,
+                view=View(height=height, round=round_ + 1),  # only higher rounds
+                has_min_round=True,
+            )
+        )
+        try:
+            while True:
+                wake = await sub.wait()
+                if wake is None:
+                    return
+                rcc = self._handle_round_change_message(
+                    View(height=height, round=round_)
+                )
+                if rcc is None:
+                    continue
+                new_round = rcc.round_change_messages[0].view.round
+                signals.fire(signals.round_certificate, new_round)
+                return
+        finally:
+            self.messages.unsubscribe(sub.id)
+
+    async def _start_round(self, signals: _RoundSignals) -> None:
+        """The per-round state machine worker (reference core/ibft.go:398-429)."""
+        self.state.new_round()
+
+        validator_id = self.backend.id()
+        view = self.state.view
+
+        if self.backend.is_proposer(validator_id, view.height, view.round):
+            self.log.info("we are the proposer")
+
+            proposal_message = await self._build_proposal(view)
+            if proposal_message is None:
+                self.log.error("unable to build proposal")
+                return
+
+            self._accept_proposal(proposal_message)
+            self.log.debug("block proposal accepted")
+
+            self._send_preprepare_message(proposal_message)
+            self.log.debug("pre-prepare message multicasted")
+
+        await self._run_states(signals)
+
+    # -- state machine loop (reference core/ibft.go:554-576) ----------------
+
+    async def _run_states(self, signals: _RoundSignals) -> None:
+        while True:
+            name = self.state.name
+            if name == StateName.NEW_ROUND:
+                done = await self._run_new_round()
+            elif name == StateName.PREPARE:
+                done = await self._run_prepare()
+            elif name == StateName.COMMIT:
+                done = await self._run_commit()
+            else:  # FIN
+                signals.fire(signals.round_done)
+                return
+            if done:
+                # Subscription closed from under us (store shut down) — the
+                # asyncio analogue of the reference's errTimeoutExpired exit.
+                return
+
+    async def _run_new_round(self) -> bool:
+        """Wait for and validate a proposal (reference core/ibft.go:579-625).
+
+        Returns True when the engine should stop running states.
+        """
+        self.log.debug("enter: new round state")
+        view = self.state.view
+        sub = self._subscribe(
+            SubscriptionDetails(message_type=MessageType.PREPREPARE, view=view)
+        )
+        try:
+            while True:
+                wake = await sub.wait()
+                if wake is None:
+                    return True
+                proposal_message = self._handle_preprepare(view)
+                if proposal_message is None:
+                    continue
+
+                self.state.set_proposal_message(proposal_message)
+                self._send_prepare_message(view)
+                self.log.debug("prepare message multicasted")
+                self.state.change_state(StateName.PREPARE)
+                return False
+        finally:
+            self.messages.unsubscribe(sub.id)
+            self.log.debug("exit: new round state")
+
+    async def _run_prepare(self) -> bool:
+        """Wait for a prepare quorum (reference core/ibft.go:816-851)."""
+        self.log.debug("enter: prepare state")
+        view = self.state.view
+        sub = self._subscribe(
+            SubscriptionDetails(message_type=MessageType.PREPARE, view=view)
+        )
+        try:
+            while True:
+                wake = await sub.wait()
+                if wake is None:
+                    return True
+                if not self._handle_prepare(view):
+                    continue
+                return False
+        finally:
+            self.messages.unsubscribe(sub.id)
+            self.log.debug("exit: prepare state")
+
+    async def _run_commit(self) -> bool:
+        """Wait for a commit quorum (reference core/ibft.go:892-927)."""
+        self.log.debug("enter: commit state")
+        view = self.state.view
+        sub = self._subscribe(
+            SubscriptionDetails(message_type=MessageType.COMMIT, view=view)
+        )
+        try:
+            while True:
+                wake = await sub.wait()
+                if wake is None:
+                    return True
+                if not self._handle_commit(view):
+                    continue
+                return False
+        finally:
+            self.messages.unsubscribe(sub.id)
+            self.log.debug("exit: commit state")
+
+    # -- message handling ---------------------------------------------------
+
+    def _handle_preprepare(self, view: View) -> Optional[IbftMessage]:
+        """Fetch-and-validate proposals for a view (reference core/ibft.go:792-813)."""
+
+        def is_valid_preprepare(message: IbftMessage) -> bool:
+            if view.round == 0:
+                return self._validate_proposal_0(message, view)
+            return self._validate_proposal(message, view)
+
+        msgs = self.messages.get_valid_messages(
+            view, MessageType.PREPREPARE, is_valid_preprepare
+        )
+        return msgs[0] if msgs else None
+
+    def _validate_proposal_common(self, msg: IbftMessage, view: View) -> bool:
+        """Validations shared by all rounds (reference core/ibft.go:629-655)."""
+        proposal = helpers.extract_proposal(msg)
+        proposal_hash = helpers.extract_proposal_hash(msg)
+
+        if proposal is None:
+            return False
+        # round matches
+        if proposal.round != view.round:
+            return False
+        # sender is the proposer for this view
+        if not self.backend.is_proposer(msg.sender, view.height, view.round):
+            return False
+        # hash matches keccak(proposal)
+        if not self.backend.is_valid_proposal_hash(proposal, proposal_hash or b""):
+            return False
+        # the embedder accepts the proposal body
+        return self.backend.is_valid_proposal(proposal.raw_proposal)
+
+    def _validate_proposal_0(self, msg: IbftMessage, view: View) -> bool:
+        """Round-0 proposal validation (reference core/ibft.go:658-680)."""
+        if msg.view is None or msg.view.round != 0:
+            return False
+        if not self._validate_proposal_common(msg, view):
+            return False
+        # we must not be the proposer ourselves
+        return not self.backend.is_proposer(self.backend.id(), view.height, view.round)
+
+    def _validate_proposal(self, msg: IbftMessage, view: View) -> bool:
+        """Round-N proposal validation with RCC (reference core/ibft.go:683-788)."""
+        height, round_ = view.height, view.round
+        proposal = helpers.extract_proposal(msg)
+        rcc = helpers.extract_round_change_certificate(msg)
+
+        if not self._validate_proposal_common(msg, view):
+            return False
+        if rcc is None:
+            return False
+        if not helpers.has_unique_senders(rcc.round_change_messages):
+            return False
+        if not self._has_quorum_by_msg_type(
+            rcc.round_change_messages, MessageType.ROUND_CHANGE
+        ):
+            return False
+        if self.backend.is_proposer(self.backend.id(), height, round_):
+            return False
+
+        # Structural checks on every RCC member.
+        for rc in rcc.round_change_messages:
+            if rc.type != MessageType.ROUND_CHANGE:
+                return False
+            if rc.view is None or rc.view.height != height:
+                return False
+            if rc.view.round != round_:
+                return False
+
+        # Sender validity: one device batch when available, else per-message
+        # (reference loops IsValidValidator per message, ibft.go:718-738).
+        if not self._all_senders_valid(rcc.round_change_messages):
+            return False
+
+        # maxRound re-proposal rule (reference ibft.go:740-788): among the
+        # valid PCs inside the RCC, the proposal must hash-match the prepared
+        # proposal of the highest prepared round.
+        max_round: Optional[int] = None
+        expected_hash: Optional[bytes] = None
+        for rc_message in rcc.round_change_messages:
+            cert = helpers.extract_latest_pc(rc_message)
+            if cert is None or not self._valid_pc(cert, msg.view.round, height):
+                continue
+            assert cert.proposal_message is not None  # _valid_pc guarantees
+            cert_round = cert.proposal_message.view.round
+            cert_hash = helpers.extract_proposal_hash(cert.proposal_message)
+            if max_round is None or cert_round >= max_round:
+                max_round = cert_round
+                expected_hash = cert_hash
+
+        if max_round is None:
+            return True
+
+        assert proposal is not None  # _validate_proposal_common guarantees
+        return self.backend.is_valid_proposal_hash(
+            Proposal(raw_proposal=proposal.raw_proposal, round=max_round),
+            expected_hash or b"",
+        )
+
+    def _handle_prepare(self, view: View) -> bool:
+        """Drain PREPAREs; move to commit on quorum (reference core/ibft.go:855-889)."""
+
+        def is_valid_prepare(message: IbftMessage) -> bool:
+            proposal = self.state.proposal
+            if proposal is None:
+                return False
+            return self.backend.is_valid_proposal_hash(
+                proposal, helpers.extract_prepare_hash(message) or b""
+            )
+
+        prepare_messages = self.messages.get_valid_messages(
+            view, MessageType.PREPARE, is_valid_prepare
+        )
+
+        if not self._has_quorum_by_msg_type(prepare_messages, MessageType.PREPARE):
+            return False
+
+        self._send_commit_message(view)
+        self.log.debug("commit message multicasted")
+
+        self.state.finalize_prepare(
+            PreparedCertificate(
+                proposal_message=self.state.proposal_message,
+                prepare_messages=prepare_messages,
+            ),
+            self.state.proposal,
+        )
+        return True
+
+    def _handle_commit(self, view: View) -> bool:
+        """Drain COMMITs; move to fin on quorum (reference core/ibft.go:931-967).
+
+        With a batch verifier, this is the TPU hot path: all seals for the
+        view are verified in one device call instead of one Verifier call per
+        message under the store lock.
+        """
+        commit_messages = self._drain_valid_commits(view)
+        if not self._has_quorum_by_msg_type(commit_messages, MessageType.COMMIT):
+            return False
+
+        try:
+            commit_seals = helpers.extract_committed_seals(commit_messages)
+        except helpers.WrongCommitMessageTypeError as err:  # safe check
+            self.log.error("failed to extract committed seals", err)
+            return False
+
+        self.state.set_committed_seals(commit_seals)
+        self.state.change_state(StateName.FIN)
+        return True
+
+    def _drain_valid_commits(self, view: View) -> list[IbftMessage]:
+        """Validity-filtered COMMIT drain — batched when possible."""
+        proposal = self.state.proposal
+
+        if self.batch_verifier is None or proposal is None:
+            # Reference path: per-message predicates inside the store lock.
+            def is_valid_commit(message: IbftMessage) -> bool:
+                proposal_hash = helpers.extract_commit_hash(message)
+                committed_seal = helpers.extract_committed_seal(message)
+                if proposal is None or committed_seal is None:
+                    return False
+                if not self.backend.is_valid_proposal_hash(
+                    proposal, proposal_hash or b""
+                ):
+                    return False
+                return self.backend.is_valid_committed_seal(
+                    proposal_hash or b"", committed_seal
+                )
+
+            return self.messages.get_valid_messages(
+                view, MessageType.COMMIT, is_valid_commit
+            )
+
+        # Batched path: snapshot, one host pass for the (cheap, cacheable)
+        # hash equality, one device batch for the (expensive) seal sigs.
+        snapshot = self.messages.snapshot_view(view, MessageType.COMMIT)
+        if not snapshot:
+            return []
+
+        candidates: list[tuple[IbftMessage, bytes, CommittedSeal]] = []
+        invalid: list[IbftMessage] = []
+        for message in snapshot:
+            proposal_hash = helpers.extract_commit_hash(message)
+            committed_seal = helpers.extract_committed_seal(message)
+            if (
+                committed_seal is None
+                or not self.backend.is_valid_proposal_hash(
+                    proposal, proposal_hash or b""
+                )
+            ):
+                invalid.append(message)
+                continue
+            candidates.append((message, proposal_hash or b"", committed_seal))
+
+        valid_messages: list[IbftMessage] = []
+        if candidates:
+            # All candidates share the proposal hash (hash check passed), so
+            # one batch per view suffices.
+            mask = self.batch_verifier.verify_committed_seals(
+                candidates[0][1], [seal for _, _, seal in candidates]
+            )
+            for (message, _, _), ok in zip(candidates, mask):
+                if bool(ok):
+                    valid_messages.append(message)
+                else:
+                    invalid.append(message)
+
+        if invalid:
+            self.messages.remove_messages(view, MessageType.COMMIT, invalid)
+        return valid_messages
+
+    def _all_senders_valid(self, msgs: Sequence[IbftMessage]) -> bool:
+        """IsValidValidator over a message set — batched when possible."""
+        if not msgs:
+            return True
+        if self.batch_verifier is not None:
+            mask = self.batch_verifier.verify_senders(list(msgs))
+            return bool(all(bool(x) for x in mask))
+        return all(self.backend.is_valid_validator(m) for m in msgs)
+
+    # -- round change / certificates ----------------------------------------
+
+    async def _wait_for_rcc(
+        self, height: int, round_: int
+    ) -> Optional[RoundChangeCertificate]:
+        """Block until a valid RCC materializes (reference core/ibft.go:432-466)."""
+        view = View(height=height, round=round_)
+        sub = self._subscribe(
+            SubscriptionDetails(message_type=MessageType.ROUND_CHANGE, view=view)
+        )
+        try:
+            while True:
+                wake = await sub.wait()
+                if wake is None:
+                    return None
+                rcc = self._handle_round_change_message(view)
+                if rcc is None:
+                    continue
+                return rcc
+        finally:
+            self.messages.unsubscribe(sub.id)
+
+    def _handle_round_change_message(
+        self, view: View
+    ) -> Optional[RoundChangeCertificate]:
+        """Validate RC messages and build an RCC (reference core/ibft.go:470-512)."""
+        height = view.height
+        has_accepted_proposal = self.state.proposal is not None
+
+        def is_valid_msg(msg: IbftMessage) -> bool:
+            proposal = helpers.extract_last_prepared_proposal(msg)
+            certificate = helpers.extract_latest_pc(msg)
+            if msg.view is None:
+                return False
+            if not self._valid_pc(certificate, msg.view.round, height):
+                return False
+            return self._proposal_matches_certificate(proposal, certificate)
+
+        def is_valid_rcc(round_: int, msgs: list[IbftMessage]) -> bool:
+            # Accept an RCC for our own round only if we have not accepted a
+            # proposal in it (reference ibft.go:489-497).
+            if round_ == view.round and has_accepted_proposal:
+                return False
+            return self._has_quorum_by_msg_type(msgs, MessageType.ROUND_CHANGE)
+
+        extended_rcc = self.messages.get_extended_rcc(
+            height, is_valid_msg, is_valid_rcc
+        )
+        if not extended_rcc:
+            return None
+        return RoundChangeCertificate(round_change_messages=list(extended_rcc))
+
+    def _proposal_matches_certificate(
+        self,
+        proposal: Optional[Proposal],
+        certificate: Optional[PreparedCertificate],
+    ) -> bool:
+        """PC must accompany — and hash-match — a prepared proposal
+        (reference core/ibft.go:516-551)."""
+        if proposal is None and certificate is None:
+            return True
+        if certificate is None:
+            return False
+        # NOTE: proposal may be None here with a set certificate; like the
+        # reference we defer to the hash check (IsValidProposalHash(nil, ..)).
+        hashes: list[bytes] = [
+            helpers.extract_proposal_hash(certificate.proposal_message) or b""
+            if certificate.proposal_message is not None
+            else b""
+        ]
+        for msg in certificate.prepare_messages or ():
+            hashes.append(helpers.extract_prepare_hash(msg) or b"")
+
+        return all(
+            self.backend.is_valid_proposal_hash(
+                proposal if proposal is not None else Proposal(), h
+            )
+            for h in hashes
+        )
+
+    def _valid_pc(
+        self,
+        certificate: Optional[PreparedCertificate],
+        round_limit: int,
+        height: int,
+    ) -> bool:
+        """Prepared-certificate validity (reference core/ibft.go:1161-1231)."""
+        if certificate is None:
+            # PCs that are not set are valid by default.
+            return True
+
+        if certificate.proposal_message is None or certificate.prepare_messages is None:
+            return False
+
+        all_messages = [certificate.proposal_message, *certificate.prepare_messages]
+
+        # Quorum over PP+P senders (mixed types: use HasQuorum directly).
+        if not self.validator_manager.has_quorum(senders_of(all_messages)):
+            return False
+
+        if certificate.proposal_message.type != MessageType.PREPREPARE:
+            return False
+        if any(
+            m.type != MessageType.PREPARE for m in certificate.prepare_messages
+        ):
+            return False
+
+        # Same height/round/hash, unique senders.
+        if not helpers.are_valid_pc_messages(all_messages, height, round_limit):
+            return False
+
+        proposal_msg = certificate.proposal_message
+        if proposal_msg.view is None:
+            return False
+        if not self.backend.is_proposer(
+            proposal_msg.sender, proposal_msg.view.height, proposal_msg.view.round
+        ):
+            return False
+
+        # Sender signatures: proposal + each prepare (batched when possible).
+        if not self._all_senders_valid(all_messages):
+            return False
+
+        # Prepare messages must come from validators that are NOT the
+        # proposer for their view.
+        for message in certificate.prepare_messages:
+            if message.view is None:
+                return False
+            if self.backend.is_proposer(
+                message.sender, message.view.height, message.view.round
+            ):
+                return False
+
+        return True
+
+    # -- proposal building (reference core/ibft.go:1005-1091) ---------------
+
+    async def _build_proposal(self, view: View) -> Optional[IbftMessage]:
+        height, round_ = view.height, view.round
+
+        if round_ == 0:
+            raw_proposal = self.backend.build_proposal(View(height=height, round=round_))
+            return self.backend.build_preprepare_message(
+                raw_proposal, None, View(height=height, round=round_)
+            )
+
+        # round > 0 needs an RCC
+        rcc = await self._wait_for_rcc(height, round_)
+        if rcc is None:
+            return None  # store shut down
+
+        # Re-propose the prepared proposal of the highest prepared round
+        # carried inside the RCC, if any (maxRound rule, ibft.go:1036-1063).
+        previous_proposal: Optional[bytes] = None
+        max_round = 0
+        for msg in rcc.round_change_messages:
+            latest_pc = helpers.extract_latest_pc(msg)
+            if latest_pc is None or latest_pc.proposal_message is None:
+                continue
+            proposal = helpers.extract_proposal(latest_pc.proposal_message)
+            if proposal is None:
+                continue
+            cert_round = proposal.round
+            if previous_proposal is not None and cert_round <= max_round:
+                continue
+            last_pb = helpers.extract_last_prepared_proposal(msg)
+            if last_pb is None:
+                continue
+            previous_proposal = last_pb.raw_proposal
+            max_round = cert_round
+
+        if previous_proposal is None:
+            raw_proposal = self.backend.build_proposal(View(height=height, round=round_))
+            return self.backend.build_preprepare_message(
+                raw_proposal, rcc, View(height=height, round=round_)
+            )
+
+        return self.backend.build_preprepare_message(
+            previous_proposal, rcc, View(height=height, round=round_)
+        )
+
+    # -- inbound path (reference core/ibft.go:1101-1149) --------------------
+
+    def add_message(self, message: Optional[IbftMessage]) -> None:
+        """Feed one message into the engine (thread-safe).
+
+        Validates the sender signature eagerly, stores, and signals
+        subscribers when the view's message set became quorum-capable.
+        """
+        if message is None:
+            return
+        if not self._is_acceptable_message(message):
+            return
+        self.messages.add_message(message)
+        self._signal_if_quorum(message.view, message.type)
+
+    def add_messages(self, batch: Sequence[IbftMessage]) -> None:
+        """Batched inbound path — the TPU-native ingress.
+
+        Sender signatures for the whole batch are verified in one device call
+        (when a batch verifier is present), then each message passes the same
+        height/round acceptance gate as ``add_message``.  Observable semantics
+        match N calls to ``add_message``; cost is one kernel launch.
+        """
+        if not batch:
+            return
+        if self.batch_verifier is not None:
+            mask = self.batch_verifier.verify_senders(list(batch))
+            accepted = [m for m, ok in zip(batch, mask) if bool(ok)]
+        else:
+            accepted = [m for m in batch if self.backend.is_valid_validator(m)]
+
+        # Store everything first, then signal once per (view, type) key —
+        # signaling mid-batch could find quorum incomplete and never re-check.
+        to_signal: dict[tuple[int, int, int], tuple[View, object]] = {}
+        for message in accepted:
+            if not self._gate_height_round(message):
+                continue
+            self.messages.add_message(message)
+            if message.view is not None:
+                key = (message.view.height, message.view.round, int(message.type))
+                to_signal.setdefault(key, (message.view, message.type))
+        for view, message_type in to_signal.values():
+            self._signal_if_quorum(view, message_type)
+
+    def _signal_if_quorum(self, view: Optional[View], message_type) -> None:
+        """Signal subscribers when quorum became possible
+        (reference core/ibft.go:1111-1121)."""
+        if view is None or view.height != self.state.height:
+            return
+        msgs = self.messages.get_valid_messages(view, message_type, lambda _m: True)
+        if self._has_quorum_by_msg_type(msgs, message_type):
+            self.messages.signal_event(message_type, view)
+
+    def _is_acceptable_message(self, message: IbftMessage) -> bool:
+        """Inbound acceptance gate (reference core/ibft.go:1126-1149)."""
+        # sender signature + validator-set membership (embedder crypto)
+        if not self.backend.is_valid_validator(message):
+            return False
+        return self._gate_height_round(message)
+
+    def _gate_height_round(self, message: IbftMessage) -> bool:
+        if message.view is None:
+            return False
+        state_height = self.state.height
+        if state_height > message.view.height:
+            return False
+        if state_height == message.view.height:
+            return message.view.round >= self.state.round
+        return True
+
+    # -- quorum dispatch (reference core/ibft.go:1272-1284) -----------------
+
+    def _has_quorum_by_msg_type(
+        self, msgs: Sequence[IbftMessage], message_type
+    ) -> bool:
+        if message_type == MessageType.PREPREPARE:
+            return len(msgs) >= 1
+        if message_type == MessageType.PREPARE:
+            return self.validator_manager.has_prepare_quorum(
+                self.state.name, self.state.proposal_message, msgs
+            )
+        if message_type in (MessageType.ROUND_CHANGE, MessageType.COMMIT):
+            return self.validator_manager.has_quorum(senders_of(msgs))
+        return False
+
+    def _subscribe(self, details: SubscriptionDetails):
+        """Subscribe-then-recheck (closes the missed-message race;
+        reference core/ibft.go:1286-1298)."""
+        subscription = self.messages.subscribe(details)
+        msgs = self.messages.get_valid_messages(
+            details.view, details.message_type, lambda _m: True
+        )
+        if self._has_quorum_by_msg_type(msgs, details.message_type):
+            self.messages.signal_event(details.message_type, details.view)
+        return subscription
+
+    # -- state helpers ------------------------------------------------------
+
+    def _move_to_new_round(self, round_: int) -> None:
+        """(reference core/ibft.go:994-1003)"""
+        self.state.set_view(View(height=self.state.height, round=round_))
+        self.state.set_round_started(False)
+        self.state.set_proposal_message(None)
+        self.state.change_state(StateName.NEW_ROUND)
+
+    def _accept_proposal(self, proposal_message: IbftMessage) -> None:
+        """Accept a proposal and move to PREPARE (reference core/ibft.go:1094-1098)."""
+        self.state.set_proposal_message(proposal_message)
+        self.state.change_state(StateName.PREPARE)
+
+    def _insert_block(self) -> None:
+        """Insert the finalized block and GC (reference core/ibft.go:978-991)."""
+        self.backend.insert_proposal(
+            Proposal(
+                raw_proposal=self.state.raw_proposal or b"",
+                round=self.state.round,
+            ),
+            self.state.committed_seals,
+        )
+        self.messages.prune_by_height(self.state.height)
+
+    # -- outbound (reference core/ibft.go:1234-1270) ------------------------
+
+    def _send_preprepare_message(self, message: IbftMessage) -> None:
+        self.transport.multicast(message)
+
+    def _send_round_change_message(self, height: int, new_round: int) -> None:
+        self.transport.multicast(
+            self.backend.build_round_change_message(
+                self.state.latest_prepared_proposal,
+                self.state.latest_pc,
+                View(height=height, round=new_round),
+            )
+        )
+
+    def _send_prepare_message(self, view: View) -> None:
+        self.transport.multicast(
+            self.backend.build_prepare_message(self.state.proposal_hash or b"", view)
+        )
+
+    def _send_commit_message(self, view: View) -> None:
+        self.transport.multicast(
+            self.backend.build_commit_message(self.state.proposal_hash or b"", view)
+        )

@@ -1,0 +1,169 @@
+"""Per-sequence mutable consensus state.
+
+Re-design of the reference's ``state`` struct (core/state.go:10-221).  The
+engine is asyncio-single-owner, but the embedder may read state from other
+threads (e.g. metrics scrapers), so mutations stay behind an RLock exactly as
+the reference guards them with an RWMutex.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Optional
+
+from ..messages import helpers
+from ..messages.helpers import CommittedSeal
+from ..messages.wire import IbftMessage, PreparedCertificate, Proposal, View
+
+
+class StateName(enum.IntEnum):
+    """State machine phases (reference core/state.go:10-32)."""
+
+    NEW_ROUND = 0
+    PREPARE = 1
+    COMMIT = 2
+    FIN = 3
+
+    def __str__(self) -> str:  # parity with stateType.String()
+        return {
+            StateName.NEW_ROUND: "new round",
+            StateName.PREPARE: "prepare",
+            StateName.COMMIT: "commit",
+            StateName.FIN: "fin",
+        }[self]
+
+
+class SequenceState:
+    """Mutex-guarded per-height state (reference core/state.go:34-57)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._view = View(height=0, round=0)
+        self._latest_pc: Optional[PreparedCertificate] = None
+        self._latest_prepared_proposal: Optional[Proposal] = None
+        self._proposal_message: Optional[IbftMessage] = None
+        self._seals: list[CommittedSeal] = []
+        self._round_started = False
+        self._name = StateName.NEW_ROUND
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def view(self) -> View:
+        """Copy of the current view (reference core/state.go:59-67)."""
+        with self._lock:
+            return self._view.copy()
+
+    @property
+    def height(self) -> int:
+        with self._lock:
+            return self._view.height
+
+    @property
+    def round(self) -> int:
+        with self._lock:
+            return self._view.round
+
+    def set_view(self, view: View) -> None:
+        with self._lock:
+            self._view = view
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def reset(self, height: int) -> None:
+        """Wipe per-height state (reference core/state.go:69-84)."""
+        with self._lock:
+            self._seals = []
+            self._round_started = False
+            self._name = StateName.NEW_ROUND
+            self._proposal_message = None
+            self._latest_pc = None
+            self._latest_prepared_proposal = None
+            self._view = View(height=height, round=0)
+
+    def new_round(self) -> None:
+        """Kick off the round once (idempotent; reference core/state.go:198-207)."""
+        with self._lock:
+            if not self._round_started:
+                self._name = StateName.NEW_ROUND
+                self._round_started = True
+
+    def finalize_prepare(
+        self, certificate: PreparedCertificate, latest_ppb: Optional[Proposal]
+    ) -> None:
+        """Pin the PC and move to commit (reference core/state.go:209-221)."""
+        with self._lock:
+            self._latest_pc = certificate
+            self._latest_prepared_proposal = latest_ppb
+            self._name = StateName.COMMIT
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def latest_pc(self) -> Optional[PreparedCertificate]:
+        with self._lock:
+            return self._latest_pc
+
+    @property
+    def latest_prepared_proposal(self) -> Optional[Proposal]:
+        with self._lock:
+            return self._latest_prepared_proposal
+
+    @property
+    def proposal_message(self) -> Optional[IbftMessage]:
+        with self._lock:
+            return self._proposal_message
+
+    def set_proposal_message(self, message: Optional[IbftMessage]) -> None:
+        with self._lock:
+            self._proposal_message = message
+
+    @property
+    def proposal_hash(self) -> Optional[bytes]:
+        """Hash of the accepted proposal (reference core/state.go:107-112)."""
+        with self._lock:
+            if self._proposal_message is None:
+                return None
+            return helpers.extract_proposal_hash(self._proposal_message)
+
+    @property
+    def proposal(self) -> Optional[Proposal]:
+        """Accepted proposal, if any (reference core/state.go:135-144)."""
+        with self._lock:
+            if self._proposal_message is None:
+                return None
+            return helpers.extract_proposal(self._proposal_message)
+
+    @property
+    def raw_proposal(self) -> Optional[bytes]:
+        """Raw bytes of the accepted proposal (reference core/state.go:146-154)."""
+        proposal = self.proposal
+        return proposal.raw_proposal if proposal is not None else None
+
+    @property
+    def committed_seals(self) -> list[CommittedSeal]:
+        with self._lock:
+            return list(self._seals)
+
+    def set_committed_seals(self, seals: list[CommittedSeal]) -> None:
+        with self._lock:
+            self._seals = list(seals)
+
+    @property
+    def name(self) -> StateName:
+        with self._lock:
+            return self._name
+
+    def change_state(self, name: StateName) -> None:
+        with self._lock:
+            self._name = name
+
+    @property
+    def round_started(self) -> bool:
+        with self._lock:
+            return self._round_started
+
+    def set_round_started(self, started: bool) -> None:
+        with self._lock:
+            self._round_started = started

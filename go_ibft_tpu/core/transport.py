@@ -1,0 +1,50 @@
+"""Transport seam: multicast out, add_message in.
+
+The reference's entire comm layer is a one-method interface
+(core/transport.go:7-10); gossip lives in the embedder.  This build keeps the
+seam; :class:`LoopbackTransport` below is the in-process fan-out for tests and
+single-host clusters (the reference's test harness pattern,
+core/helpers_test.go:227-231).  Further backends per SURVEY.md §5 — a
+gRPC/DCN transport for multi-host deployments and the ICI lock-step
+collective transport (multicast as an all_gather of fixed-size message
+tensors) — plug into the same ``Transport`` protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+from ..messages.wire import IbftMessage
+
+
+class Transport(Protocol):
+    """Fire-and-forget multicast (reference core/transport.go:7-10).
+
+    Self-delivery is expected: nodes receive their own messages through the
+    same path as everyone else's.
+    """
+
+    def multicast(self, message: IbftMessage) -> None: ...
+
+
+class LoopbackTransport:
+    """In-process multicast: deliver to every registered node, self included.
+
+    Mirrors the reference test clusters' gossip closure
+    (core/mock_test.go:546-550, core/helpers_test.go:227-231).  Delivery is
+    synchronous and in registration order; a delivery hook lets fault tests
+    drop or mutate messages per (sender, receiver).
+    """
+
+    def __init__(self) -> None:
+        self._receivers: list[Callable[[IbftMessage], None]] = []
+        # Optional fault hook: (message, receiver_index) -> deliver?
+        self.should_deliver: Callable[[IbftMessage, int], bool] = lambda m, i: True
+
+    def register(self, add_message: Callable[[IbftMessage], None]) -> None:
+        self._receivers.append(add_message)
+
+    def multicast(self, message: IbftMessage) -> None:
+        for idx, deliver in enumerate(self._receivers):
+            if self.should_deliver(message, idx):
+                deliver(message)

@@ -2,8 +2,7 @@
 
 Mirrors the behavior of the reference's messages/helpers.go:16-227: payload
 extraction out of the oneof envelope and the PreparedCertificate message-set
-validity rules.  The equality-heavy PC check additionally has a vectorized
-fast path used by the batch verifier (go_ibft_tpu.verify).
+validity rules.
 """
 
 from __future__ import annotations

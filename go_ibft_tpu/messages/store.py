@@ -71,6 +71,11 @@ class MessageStore:
         """
         if message.view is None:
             return
+        if message.type not in self._locks:
+            # Unknown open-enum type preserved by the wire codec: not a
+            # consensus message, nothing subscribes to it — drop it instead
+            # of crashing the embedder's receive path.
+            return
         with self._locks[message.type]:
             height_map = self._maps[message.type]
             round_map = height_map.setdefault(message.view.height, {})
@@ -125,21 +130,29 @@ class MessageStore:
             return valid
 
     def remove_messages(
-        self, view: View, message_type: MessageType, senders: Iterable[bytes]
+        self,
+        view: View,
+        message_type: MessageType,
+        invalid: Iterable[IbftMessage],
     ) -> None:
-        """Prune specific senders' messages for a view.
+        """Prune specific messages for a view, by identity.
 
         Batch-verification support: the engine fetches a whole view's messages
         with a trivial filter, verifies them in one device batch, then prunes
         the failures here — observationally equivalent to the reference's
         per-message ``isValid`` pruning inside GetValidMessages.
+
+        Removal compares message identity, not just sender: a sender may have
+        replaced its message between the snapshot and this call (the verify
+        window holds no store lock), and the replacement must survive.
         """
         with self._locks[message_type]:
             sender_map = self._maps[message_type].get(view.height, {}).get(view.round)
             if not sender_map:
                 return
-            for sender in senders:
-                sender_map.pop(sender, None)
+            for message in invalid:
+                if sender_map.get(message.sender) is message:
+                    del sender_map[message.sender]
 
     def get_extended_rcc(
         self,
@@ -152,10 +165,11 @@ class MessageStore:
         Mirrors GetExtendedRCC (reference messages/messages.go:202-245).  The
         reference iterates the round map in Go's random order with a
         ``round <= highestRound`` skip; the fixed point of that loop is "the
-        highest round whose valid-message set passes ``is_valid_rcc``, rounds
-        processed ascending" — and round 0 can never win (highestRound starts
-        at 0).  We iterate rounds in ascending order, which lands on the same
-        result deterministically.
+        highest round whose valid-message set passes ``is_valid_rcc``" — and
+        round 0 can never win (highestRound starts at 0).  We iterate rounds
+        in descending order with an early exit, which lands on the same
+        result deterministically and never pays the signature-heavy
+        ``is_valid_message`` predicate for dominated rounds.
         """
         message_type = MessageType.ROUND_CHANGE
         with self._locks[message_type]:

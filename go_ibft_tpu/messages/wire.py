@@ -75,6 +75,9 @@ def _decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
+            if result > 0xFFFFFFFFFFFFFFFF:
+                # protobuf varints are at most uint64
+                raise ValueError("varint overflows uint64")
             return result, pos
         shift += 7
         if shift > 70:

@@ -1,0 +1,5 @@
+"""Utilities: metrics registry, logging adapters."""
+
+from . import metrics
+
+__all__ = ["metrics"]

@@ -1,0 +1,60 @@
+"""Minimal metrics registry.
+
+The reference emits exactly one gauge family via armon/go-metrics
+(core/ibft.go:138-141): ``go-ibft.{sequence|round}.duration``.  This registry
+keeps that surface (plus histograms used by the batch verifier for per-batch
+device latency) without external dependencies; an embedder can attach a sink
+to export to Prometheus or anything else.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Optional, Sequence
+
+_lock = threading.Lock()
+_gauges: dict[tuple[str, ...], float] = {}
+_histograms: dict[tuple[str, ...], list[float]] = defaultdict(list)
+_sink: Optional[Callable[[str, tuple[str, ...], float], None]] = None
+
+
+def set_sink(sink: Optional[Callable[[str, tuple[str, ...], float], None]]) -> None:
+    """Attach a callback receiving (kind, key, value) for every sample."""
+    global _sink
+    _sink = sink
+
+
+def set_gauge(key: Sequence[str], value: float) -> None:
+    """Set a gauge (reference core/ibft.go:138-141 SetMeasurementTime)."""
+    key = tuple(key)
+    with _lock:
+        _gauges[key] = value
+    if _sink is not None:
+        _sink("gauge", key, value)
+
+
+def get_gauge(key: Sequence[str]) -> Optional[float]:
+    with _lock:
+        return _gauges.get(tuple(key))
+
+
+def observe(key: Sequence[str], value: float) -> None:
+    """Record a histogram sample (e.g. batch-verify kernel latency)."""
+    key = tuple(key)
+    with _lock:
+        _histograms[key].append(value)
+    if _sink is not None:
+        _sink("histogram", key, value)
+
+
+def get_histogram(key: Sequence[str]) -> list[float]:
+    with _lock:
+        return list(_histograms.get(tuple(key), ()))
+
+
+def reset() -> None:
+    """Clear all recorded metrics (test support)."""
+    with _lock:
+        _gauges.clear()
+        _histograms.clear()

@@ -1,0 +1,393 @@
+"""In-process cluster harness for consensus tests.
+
+Ports the reference's test fixtures: the function-pointer mock backend
+(core/mock_test.go:72-349) and the fault-injection cluster with loopback
+gossip, per-node offline/faulty/byzantine flags and round-robin proposer
+(core/helpers_test.go:39-295).  Multi-node consensus is simulated without any
+real network: every node's multicast loops back into every node's
+add_message.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Callable, Optional, Sequence
+
+from go_ibft_tpu.core import IBFT, StateName  # noqa: F401
+from go_ibft_tpu.messages import (
+    CommitMessage,
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    PrepareMessage,
+    PrePrepareMessage,
+    Proposal,
+    RoundChangeCertificate,
+    RoundChangeMessage,
+    View,
+)
+
+VALID_BLOCK = b"valid ethereum block"
+VALID_PROPOSAL_HASH = b"valid proposal hash"
+VALID_COMMITTED_SEAL = b"valid committed seal"
+
+TEST_ROUND_TIMEOUT = 0.15  # the reference uses 1s in cluster tests
+
+
+class NullLogger:
+    def info(self, msg, *args):  # noqa: D102
+        pass
+
+    def debug(self, msg, *args):  # noqa: D102
+        pass
+
+    def error(self, msg, *args):  # noqa: D102
+        pass
+
+
+# -- basic message builders (reference core/consensus_test.go:28-108) --------
+
+
+def build_preprepare(
+    raw_proposal: bytes,
+    proposal_hash: bytes,
+    certificate: Optional[RoundChangeCertificate],
+    view: View,
+    sender: bytes,
+) -> IbftMessage:
+    return IbftMessage(
+        view=view.copy(),
+        sender=sender,
+        type=MessageType.PREPREPARE,
+        preprepare_data=PrePrepareMessage(
+            proposal=Proposal(raw_proposal=raw_proposal, round=view.round),
+            proposal_hash=proposal_hash,
+            certificate=certificate,
+        ),
+    )
+
+
+def build_prepare(proposal_hash: bytes, view: View, sender: bytes) -> IbftMessage:
+    return IbftMessage(
+        view=view.copy(),
+        sender=sender,
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=proposal_hash),
+    )
+
+
+def build_commit(
+    proposal_hash: bytes, view: View, sender: bytes, seal: bytes = VALID_COMMITTED_SEAL
+) -> IbftMessage:
+    return IbftMessage(
+        view=view.copy(),
+        sender=sender,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(proposal_hash=proposal_hash, committed_seal=seal),
+    )
+
+
+def build_round_change(
+    proposal: Optional[Proposal],
+    certificate: Optional[PreparedCertificate],
+    view: View,
+    sender: bytes,
+) -> IbftMessage:
+    return IbftMessage(
+        view=view.copy(),
+        sender=sender,
+        type=MessageType.ROUND_CHANGE,
+        round_change_data=RoundChangeMessage(
+            last_prepared_proposal=proposal,
+            latest_prepared_certificate=certificate,
+        ),
+    )
+
+
+def max_faulty(node_count: int) -> int:
+    """f = (N-1)/3 (reference core/consensus_test.go:112-114)."""
+    return (node_count - 1) // 3
+
+
+def quorum_size(node_count: int) -> int:
+    """floor(2N/3)+1 for equal voting powers (reference consensus_test.go:117-125)."""
+    return (2 * node_count) // 3 + 1
+
+
+class MockBackend:
+    """Function-pointer configurable backend (reference core/mock_test.go:72-349).
+
+    Every behavior is a swappable attribute so individual tests (and byzantine
+    nodes) can override exactly one delegate.
+    """
+
+    def __init__(self, node_id: bytes, cluster: Optional["Cluster"] = None) -> None:
+        self.node_id = node_id
+        self.cluster = cluster
+        self.inserted: list[tuple[Proposal, list]] = []
+        # Standalone (cluster-less) instances use this voting-power map.
+        self.voting_powers: dict[bytes, int] = {}
+
+        # Overridable delegates
+        self.is_valid_proposal_fn: Callable[[bytes], bool] = (
+            lambda raw: raw == VALID_BLOCK
+        )
+        self.is_valid_proposal_hash_fn: Callable[[Proposal, bytes], bool] = (
+            lambda proposal, h: h == VALID_PROPOSAL_HASH
+        )
+        self.is_valid_committed_seal_fn = lambda proposal_hash, seal: True
+        self.is_valid_validator_fn: Callable[[IbftMessage], bool] = lambda msg: True
+        self.is_proposer_fn: Optional[Callable[[bytes, int, int], bool]] = None
+        self.build_proposal_fn: Callable[[View], bytes] = lambda view: VALID_BLOCK
+        self.insert_proposal_fn: Optional[Callable[[Proposal, Sequence], None]] = None
+
+        # Message builder delegates (byzantine overrides swap these)
+        self.build_preprepare_fn = build_preprepare
+        self.build_prepare_fn = build_prepare
+        self.build_commit_fn = build_commit
+        self.build_round_change_fn = build_round_change
+
+    # MessageConstructor
+    def build_preprepare_message(self, raw_proposal, certificate, view):
+        return self.build_preprepare_fn(
+            raw_proposal, VALID_PROPOSAL_HASH, certificate, view, self.node_id
+        )
+
+    def build_prepare_message(self, proposal_hash, view):
+        return self.build_prepare_fn(proposal_hash, view, self.node_id)
+
+    def build_commit_message(self, proposal_hash, view):
+        return self.build_commit_fn(proposal_hash, view, self.node_id)
+
+    def build_round_change_message(self, proposal, certificate, view):
+        return self.build_round_change_fn(proposal, certificate, view, self.node_id)
+
+    # Verifier
+    def is_valid_proposal(self, raw_proposal):
+        return self.is_valid_proposal_fn(raw_proposal)
+
+    def is_valid_validator(self, msg):
+        return self.is_valid_validator_fn(msg)
+
+    def is_proposer(self, validator_id, height, round_):
+        if self.is_proposer_fn is not None:
+            return self.is_proposer_fn(validator_id, height, round_)
+        if self.cluster is None:
+            return False
+        return self.cluster.proposer_for(height, round_) == validator_id
+
+    def is_valid_proposal_hash(self, proposal, hash_):
+        return self.is_valid_proposal_hash_fn(proposal, hash_)
+
+    def is_valid_committed_seal(self, proposal_hash, committed_seal):
+        return self.is_valid_committed_seal_fn(proposal_hash, committed_seal)
+
+    # ValidatorBackend
+    def get_voting_powers(self, height):
+        if self.cluster is None:
+            return dict(self.voting_powers)
+        return {node.address: 1 for node in self.cluster.nodes}
+
+    # Notifier
+    def round_starts(self, view):
+        return None
+
+    def sequence_cancelled(self, view):
+        return None
+
+    # Backend
+    def build_proposal(self, view):
+        return self.build_proposal_fn(view)
+
+    def insert_proposal(self, proposal, committed_seals):
+        if self.insert_proposal_fn is not None:
+            self.insert_proposal_fn(proposal, committed_seals)
+        self.inserted.append((proposal, list(committed_seals)))
+
+    def id(self):
+        return self.node_id
+
+
+class Node:
+    """One cluster member (reference core/helpers_test.go:39-74)."""
+
+    def __init__(self, address: bytes, cluster: "Cluster") -> None:
+        self.address = address
+        self.cluster = cluster
+        self.offline = False
+        self.faulty = False
+        self.byzantine = False
+        self.backend = MockBackend(address, cluster)
+        self.core = IBFT(NullLogger(), self.backend, self._transport())
+        self.core.set_base_round_timeout(TEST_ROUND_TIMEOUT)
+
+    def _transport(self):
+        node = self
+
+        class _T:
+            def multicast(self, message):
+                node.cluster.gossip(node, message)
+
+        return _T()
+
+    @property
+    def inserted_blocks(self) -> list[tuple[Proposal, list]]:
+        return self.backend.inserted
+
+
+class Cluster:
+    """Lock-step in-process cluster (reference core/helpers_test.go:165-295).
+
+    Gossip is a loopback closure into every node's add_message; per-node
+    offline/faulty flags drop messages; round-robin proposer selection.
+    """
+
+    def __init__(self, node_count: int, prefix: bytes = b"node") -> None:
+        self.nodes: list[Node] = []
+        for i in range(node_count):
+            self.nodes.append(Node(prefix + b"-" + str(i).encode(), self))
+        self._rng = random.Random(0xD1CE)
+
+    def proposer_for(self, height: int, round_: int) -> bytes:
+        """Round-robin proposer (reference core/helpers_test.go:131-139)."""
+        return self.nodes[(height + round_) % len(self.nodes)].address
+
+    def gossip(self, sender: Node, message: IbftMessage) -> None:
+        if sender.offline:
+            return
+        # Faulty nodes drop ~50% of their multicasts
+        # (reference core/drop_test.go:105-148).
+        if sender.faulty and self._rng.random() < 0.5:
+            return
+        for node in self.nodes:
+            if node.offline:
+                continue
+            node.core.add_message(message)
+
+    def set_base_timeout(self, seconds: float) -> None:
+        for node in self.nodes:
+            node.core.set_base_round_timeout(seconds)
+
+    async def progress_to_height(
+        self,
+        height: int,
+        *,
+        start_height: int = 0,
+        participants: Optional[Sequence[Node]] = None,
+        timeout: float = 10.0,
+    ) -> None:
+        """Run sequences height by height until all participants finish each
+        (reference core/helpers_test.go:241-262 progressToHeight)."""
+        nodes = list(participants) if participants is not None else self.nodes
+        for h in range(start_height, height):
+            await self.run_height(h, nodes=nodes, timeout=timeout)
+
+    async def run_height(
+        self,
+        height: int,
+        *,
+        nodes: Optional[Sequence[Node]] = None,
+        timeout: float = 10.0,
+    ) -> None:
+        nodes = list(nodes) if nodes is not None else self.nodes
+        tasks = [
+            asyncio.create_task(
+                node.core.run_sequence(height),
+                name=f"seq-{node.address.decode()}-h{height}",
+            )
+            for node in nodes
+            if not node.offline
+        ]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout)
+        finally:
+            for task in tasks:
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def run_height_quorum(
+        self, height: int, completions: int, *, timeout: float = 20.0
+    ) -> int:
+        """Run a height until at least ``completions`` nodes finish, then
+        cancel the stragglers (reference core/mock_test.go awaitNCompletions +
+        forceShutdown pattern).  Returns the number that completed."""
+        tasks = [
+            asyncio.create_task(node.core.run_sequence(height))
+            for node in self.nodes
+            if not node.offline
+        ]
+        done: set = set()
+        deadline = asyncio.get_running_loop().time() + timeout
+        pending = set(tasks)
+        while len(done) < completions:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0 or not pending:
+                break
+            just_done, pending = await asyncio.wait(
+                pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            done |= just_done
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        return len(done)
+
+    async def run_height_expect_stall(
+        self, height: int, *, stall_for: float = 1.0
+    ) -> bool:
+        """Run a height expecting NO node to finish within ``stall_for``.
+
+        Returns True when the cluster stalled (liveness lost), False when any
+        node finalized.
+        """
+        online = [n for n in self.nodes if not n.offline]
+        if not online:
+            await asyncio.sleep(stall_for)
+            return True
+        tasks = [
+            asyncio.create_task(n.core.run_sequence(height)) for n in online
+        ]
+        done, pending = await asyncio.wait(tasks, timeout=stall_for)
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        return len(done) == 0
+
+    def make_n_byzantine(self, n: int, mutator: Callable[[Node], None]) -> None:
+        """Flip the first n nodes byzantine via a delegate mutator
+        (reference core/byzantine_test.go:293-391 pattern)."""
+        for node in self.nodes[:n]:
+            node.byzantine = True
+            mutator(node)
+
+    def make_n_faulty(self, n: int) -> None:
+        for node in self.nodes[:n]:
+            node.faulty = True
+
+    def stop_n(self, n: int) -> None:
+        for node in self.nodes[:n]:
+            node.offline = True
+
+    def start_n(self, n: int) -> None:
+        for node in self.nodes[:n]:
+            node.offline = False
+
+    def shutdown(self) -> None:
+        for node in self.nodes:
+            node.core.messages.close()
+
+    # -- assertions ---------------------------------------------------------
+
+    def honest_nodes(self) -> list[Node]:
+        return [n for n in self.nodes if not (n.byzantine or n.offline or n.faulty)]
+
+    def assert_all_honest_inserted(self, height_count: int, raw: bytes = VALID_BLOCK):
+        for node in self.honest_nodes():
+            assert len(node.inserted_blocks) >= height_count, (
+                f"{node.address}: inserted {len(node.inserted_blocks)} < "
+                f"{height_count}"
+            )
+            for proposal, _seals in node.inserted_blocks[:height_count]:
+                assert proposal.raw_proposal == raw

@@ -1,0 +1,186 @@
+"""Byzantine-behavior tests.
+
+Ports the reference's core/byzantine_test.go:13-291: 6-node clusters progress
+to height 1 honestly, then maxFaulty() nodes turn Byzantine via malicious
+message-builder delegates, and the cluster must still reach height 2.
+
+Scenarios: bad hash in proposal, bad hash in prepare, +1 round in proposal,
++1 round in round-change, combined, and bad commit seal.  The "forced RC"
+proposer function (no proposer in round 0) drives the round-change/RCC path
+exactly as the reference does (byzantine_test.go:363-374).
+"""
+
+import pytest
+
+from tests.harness import (
+    VALID_COMMITTED_SEAL,
+    VALID_PROPOSAL_HASH,
+    Cluster,
+    build_commit,
+    build_preprepare,
+    build_prepare,
+    build_round_change,
+    max_faulty,
+)
+
+BAD_HASH = b"invalid proposal hash"
+BAD_SEAL = b"invalid committed seal"
+
+
+def _forced_rc_proposer(cluster: Cluster):
+    """No proposer in round 0 -> everyone round-changes; proposer for round r
+    is nodes[r % n] (reference byzantine_test.go:363-374)."""
+
+    def is_proposer(sender: bytes, height: int, round_: int) -> bool:
+        if round_ == 0:
+            return False
+        return sender == cluster.nodes[round_ % len(cluster.nodes)].address
+
+    return is_proposer
+
+
+def _use_forced_rc(cluster: Cluster) -> None:
+    fn = _forced_rc_proposer(cluster)
+    for node in cluster.nodes:
+        node.backend.is_proposer_fn = fn
+
+
+def _bad_hash_preprepare(node):
+    def build(raw_proposal, proposal_hash, certificate, view, sender):
+        hash_ = BAD_HASH if node.byzantine else proposal_hash
+        return build_preprepare(raw_proposal, hash_, certificate, view, sender)
+
+    return build
+
+
+def _bad_round_preprepare(node):
+    def build(raw_proposal, proposal_hash, certificate, view, sender):
+        if node.byzantine:
+            view = view.copy()
+            view.round += 1
+        return build_preprepare(raw_proposal, proposal_hash, certificate, view, sender)
+
+    return build
+
+
+def _bad_hash_prepare(node):
+    def build(proposal_hash, view, sender):
+        hash_ = BAD_HASH if node.byzantine else VALID_PROPOSAL_HASH
+        return build_prepare(hash_, view, sender)
+
+    return build
+
+
+def _bad_round_round_change(node):
+    def build(proposal, certificate, view, sender):
+        if node.byzantine:
+            view = view.copy()
+            view.round += 1
+        return build_round_change(proposal, certificate, view, sender)
+
+    return build
+
+
+def _bad_seal_commit(node):
+    def build(proposal_hash, view, sender):
+        seal = BAD_SEAL if node.byzantine else VALID_COMMITTED_SEAL
+        return build_commit(proposal_hash, view, sender, seal=seal)
+
+    return build
+
+
+async def _progress_with_byzantine(cluster: Cluster, mutator, *, forced_rc: bool):
+    if forced_rc:
+        _use_forced_rc(cluster)
+    try:
+        # Height 0: all honest.
+        await cluster.run_height(0, timeout=10.0)
+        cluster.assert_all_honest_inserted(1)
+
+        # Flip f nodes byzantine; cluster must still reach the next height.
+        cluster.make_n_byzantine(max_faulty(len(cluster.nodes)), mutator)
+        await cluster.run_height(1, timeout=20.0)
+        for node in cluster.nodes:
+            if not node.byzantine:
+                assert len(node.inserted_blocks) == 2
+    finally:
+        cluster.shutdown()
+
+
+async def test_byzantine_bad_hash_in_proposal():
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_preprepare_fn = _bad_hash_preprepare(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_bad_hash_in_prepare():
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_prepare_fn = _bad_hash_prepare(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=False)
+
+
+async def test_byzantine_plus_one_round_in_proposal():
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_preprepare_fn = _bad_round_preprepare(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_plus_one_round_in_round_change():
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_round_change_fn = _bad_round_round_change(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_bad_hash_proposal_and_bad_round_change():
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_preprepare_fn = _bad_hash_preprepare(node)
+        node.backend.build_round_change_fn = _bad_round_round_change(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_bad_commit_seal():
+    cluster = Cluster(6)
+    # Stricter than the reference mock (which accepts any seal): enforce seal
+    # validity so byzantine seals are actually filtered out.
+    for node in cluster.nodes:
+        node.backend.is_valid_committed_seal_fn = (
+            lambda proposal_hash, seal: seal.signature == VALID_COMMITTED_SEAL
+        )
+
+    def mutate(node):
+        node.backend.build_commit_fn = _bad_seal_commit(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=False)
+
+
+async def test_byzantine_over_limit_breaks_liveness():
+    """f+1 byzantine prepare-hash liars stall the cluster (safety holds)."""
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_prepare_fn = _bad_hash_prepare(node)
+
+    try:
+        await cluster.run_height(0, timeout=10.0)
+        cluster.make_n_byzantine(max_faulty(6) + 2, mutate)
+        stalled = await cluster.run_height_expect_stall(1, stall_for=1.0)
+        assert stalled
+        for node in cluster.nodes:
+            assert len(node.inserted_blocks) == 1  # nothing new inserted
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,97 @@
+"""Fault/drop liveness tests.
+
+Ports the reference's core/drop_test.go:
+- all nodes drop then recover (:16-81)
+- maxFaulty nodes randomly dropping 50% of multicasts over 5 heights (:105-148)
+- gradual staggered starts (:150-214)
+- the quorum boundary pair: stop f+1 => stuck, stop f => still live (:224-326)
+"""
+
+import asyncio
+
+from tests.harness import Cluster, max_faulty
+
+
+async def test_all_drop_then_recover():
+    cluster = Cluster(6)
+    try:
+        await cluster.run_height(0, timeout=10.0)
+        cluster.assert_all_honest_inserted(1)
+
+        # Everyone goes offline: no progress possible.
+        cluster.stop_n(len(cluster.nodes))
+        stalled = await cluster.run_height_expect_stall(1, stall_for=0.5)
+        assert stalled
+
+        # Everyone recovers: the next height finalizes.
+        cluster.start_n(len(cluster.nodes))
+        await cluster.run_height(1, timeout=10.0)
+        for node in cluster.nodes:
+            assert len(node.inserted_blocks) == 2
+    finally:
+        cluster.shutdown()
+
+
+async def test_faulty_nodes_dropping_half_their_messages():
+    cluster = Cluster(6)
+    try:
+        cluster.make_n_faulty(max_faulty(6))
+        for height in range(5):
+            await cluster.run_height(height, timeout=20.0)
+        for node in cluster.nodes:
+            if not node.faulty:
+                assert len(node.inserted_blocks) == 5
+    finally:
+        cluster.shutdown()
+
+
+async def test_gradual_staggered_starts():
+    """Nodes join the sequence one by one; consensus still completes
+    (reference drop_test.go:150-214 runGradualSequence)."""
+    cluster = Cluster(6)
+    try:
+        async def delayed_run(node, delay):
+            await asyncio.sleep(delay)
+            await node.core.run_sequence(0)
+
+        tasks = [
+            asyncio.create_task(delayed_run(node, 0.02 * idx))
+            for idx, node in enumerate(cluster.nodes)
+        ]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tasks), 20.0)
+        finally:
+            for task in tasks:
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        cluster.assert_all_honest_inserted(1)
+    finally:
+        cluster.shutdown()
+
+
+async def test_quorum_boundary_f_plus_one_offline_stalls():
+    cluster = Cluster(6)
+    try:
+        await cluster.run_height(0, timeout=10.0)
+        # f+1 = 2 of 6 offline: 4 online < quorum 5 -> liveness lost
+        cluster.stop_n(max_faulty(6) + 1)
+        stalled = await cluster.run_height_expect_stall(1, stall_for=1.0)
+        assert stalled
+    finally:
+        cluster.shutdown()
+
+
+async def test_quorum_boundary_f_offline_still_live():
+    cluster = Cluster(6)
+    try:
+        await cluster.run_height(0, timeout=10.0)
+        # f = 1 of 6 offline: 5 online == quorum 5 -> still live
+        cluster.stop_n(max_faulty(6))
+        await cluster.run_height(1, timeout=20.0)
+        for node in cluster.nodes:
+            if not node.offline:
+                assert len(node.inserted_blocks) == 2
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,499 @@
+"""White-box unit tests of the IBFT state machine.
+
+Ports the key scenarios of the reference's core/ibft_test.go (3,246 LoC):
+per-state behavior, validation rules, acceptance gating, timeout math,
+validPC sub-cases, and RunSequence event arbitration.
+"""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.core import IBFT, StateName, get_round_timeout
+from go_ibft_tpu.core.ibft import _NewProposalEvent, _RoundSignals
+from go_ibft_tpu.messages import (
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    Proposal,
+    RoundChangeCertificate,
+    View,
+)
+from tests.harness import (
+    VALID_BLOCK,
+    VALID_PROPOSAL_HASH,
+    MockBackend,
+    NullLogger,
+    build_commit,
+    build_preprepare,
+    build_prepare,
+    build_round_change,
+)
+
+MY_ID = b"node-0"
+PEERS = [b"node-1", b"node-2", b"node-3"]
+ALL = [MY_ID, *PEERS]
+
+
+class CapturingTransport:
+    def __init__(self):
+        self.sent: list[IbftMessage] = []
+
+    def multicast(self, message):
+        self.sent.append(message)
+
+
+def make_ibft(proposer: bytes = b"node-1"):
+    backend = MockBackend(MY_ID)
+    backend.voting_powers = {addr: 1 for addr in ALL}  # quorum 3
+    backend.is_proposer_fn = lambda sender, h, r: sender == proposer
+    transport = CapturingTransport()
+    ibft = IBFT(NullLogger(), backend, transport)
+    ibft.set_base_round_timeout(0.2)
+    ibft.validator_manager.init(0)
+    return ibft, backend, transport
+
+
+def view0() -> View:
+    return View(height=0, round=0)
+
+
+# -- timeout math (reference ibft_test.go:3066-3099) -------------------------
+
+
+@pytest.mark.parametrize(
+    "base,additional,round_,expected",
+    [
+        (10.0, 0.0, 0, 10.0),
+        (10.0, 0.0, 1, 20.0),
+        (10.0, 0.0, 2, 40.0),
+        (10.0, 0.0, 3, 80.0),
+        (10.0, 5.0, 0, 15.0),
+        (1.0, 0.0, 6, 64.0),
+    ],
+)
+def test_round_timeout_math(base, additional, round_, expected):
+    assert get_round_timeout(base, additional, round_) == expected
+
+
+# -- new round: proposer path (reference ibft_test.go:218) -------------------
+
+
+async def test_proposer_builds_and_multicasts_preprepare():
+    ibft, backend, transport = make_ibft(proposer=MY_ID)
+    ibft.state.reset(0)
+
+    signals = _RoundSignals()
+    task = asyncio.create_task(ibft._start_round(signals))
+    await asyncio.sleep(0.05)
+
+    assert ibft.state.name == StateName.PREPARE
+    assert ibft.state.proposal_message is not None
+    assert transport.sent[0].type == MessageType.PREPREPARE
+    assert transport.sent[0].preprepare_data.proposal.raw_proposal == VALID_BLOCK
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+# -- new round: validator path (reference ibft_test.go:603,701) --------------
+
+
+async def test_validator_accepts_proposal_and_prepares():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.state.reset(0)
+
+    signals = _RoundSignals()
+    task = asyncio.create_task(ibft._start_round(signals))
+    await asyncio.sleep(0.01)
+
+    proposal = build_preprepare(
+        VALID_BLOCK, VALID_PROPOSAL_HASH, None, view0(), b"node-1"
+    )
+    ibft.add_message(proposal)
+    await asyncio.sleep(0.05)
+
+    assert ibft.state.name == StateName.PREPARE
+    assert [m.type for m in transport.sent] == [MessageType.PREPARE]
+    assert transport.sent[0].prepare_data.proposal_hash == VALID_PROPOSAL_HASH
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+# -- full happy path through the states (reference ibft_test.go:870,977) -----
+
+
+async def test_states_prepare_commit_fin():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.state.reset(0)
+
+    signals = _RoundSignals()
+    task = asyncio.create_task(ibft._start_round(signals))
+    await asyncio.sleep(0.01)
+
+    ibft.add_message(
+        build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None, view0(), b"node-1")
+    )
+    await asyncio.sleep(0.02)
+    # Prepare quorum: proposer counted via proposal; 2 more preparers needed.
+    for sender in (b"node-2", b"node-3"):
+        ibft.add_message(build_prepare(VALID_PROPOSAL_HASH, view0(), sender))
+    await asyncio.sleep(0.02)
+    assert ibft.state.name == StateName.COMMIT
+    # PC pinned by finalizePrepare (reference state.go:209-221)
+    assert ibft.state.latest_pc is not None
+    assert ibft.state.latest_prepared_proposal.raw_proposal == VALID_BLOCK
+    sent_types = [m.type for m in transport.sent]
+    assert sent_types == [MessageType.PREPARE, MessageType.COMMIT]
+
+    for sender in (b"node-1", b"node-2", b"node-3"):
+        ibft.add_message(build_commit(VALID_PROPOSAL_HASH, view0(), sender))
+    await asyncio.sleep(0.02)
+
+    # round_done fired; insert and check seals
+    assert signals.round_done.done()
+    ibft._insert_block()
+    assert len(backend.inserted) == 1
+    proposal, seals = backend.inserted[0]
+    assert proposal.raw_proposal == VALID_BLOCK
+    assert len(seals) == 3
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+# -- acceptance gate (reference ibft_test.go:1103-1179) ----------------------
+
+
+def test_acceptance_gate_table():
+    ibft, backend, _ = make_ibft()
+    ibft.state.reset(5)
+    ibft.state.set_view(View(height=5, round=2))
+
+    def msg(height, round_):
+        return build_prepare(VALID_PROPOSAL_HASH, View(height=height, round=round_), b"node-1")
+
+    # invalid sender signature
+    backend.is_valid_validator_fn = lambda m: False
+    assert not ibft._is_acceptable_message(msg(5, 2))
+    backend.is_valid_validator_fn = lambda m: True
+
+    # nil view
+    bad = msg(5, 2)
+    bad.view = None
+    assert not ibft._is_acceptable_message(bad)
+
+    # lower height rejected
+    assert not ibft._is_acceptable_message(msg(4, 0))
+    # same height, lower round rejected
+    assert not ibft._is_acceptable_message(msg(5, 1))
+    # same height, same/higher round accepted
+    assert ibft._is_acceptable_message(msg(5, 2))
+    assert ibft._is_acceptable_message(msg(5, 3))
+    # higher height always accepted
+    assert ibft._is_acceptable_message(msg(6, 0))
+    ibft.messages.close()
+
+
+# -- round expiry (reference ibft_test.go:1220) ------------------------------
+
+
+async def test_round_timer_expiry_sends_round_change():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.set_base_round_timeout(0.05)
+
+    task = asyncio.create_task(ibft.run_sequence(0))
+    await asyncio.sleep(0.12)
+    # round 0 expired: round change multicast for round 1
+    rc = [m for m in transport.sent if m.type == MessageType.ROUND_CHANGE]
+    assert rc and rc[0].view.round == 1
+    assert ibft.state.round >= 1
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+# -- validPC sub-cases (reference ibft_test.go:1510 ff.) ---------------------
+
+
+def _pc(proposer=b"node-1", preparers=(b"node-2", b"node-3"), height=0, round_=0,
+        hash_=VALID_PROPOSAL_HASH):
+    proposal_msg = build_preprepare(
+        VALID_BLOCK, hash_, None, View(height=height, round=round_), proposer
+    )
+    prepares = [
+        build_prepare(hash_, View(height=height, round=round_), p) for p in preparers
+    ]
+    return PreparedCertificate(
+        proposal_message=proposal_msg, prepare_messages=prepares
+    )
+
+
+def test_valid_pc_cases():
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+
+    # no certificate: valid by default
+    assert ibft._valid_pc(None, round_limit=1, height=0)
+
+    # missing fields
+    assert not ibft._valid_pc(PreparedCertificate(), 1, 0)
+    assert not ibft._valid_pc(
+        PreparedCertificate(proposal_message=_pc().proposal_message), 1, 0
+    )
+
+    # happy case: proposer + 2 preparers = 3 senders = quorum
+    assert ibft._valid_pc(_pc(), round_limit=1, height=0)
+
+    # no quorum (PP + 1 P = 2 < 3)
+    assert not ibft._valid_pc(_pc(preparers=(b"node-2",)), 1, 0)
+
+    # proposal message not a PREPREPARE
+    pc = _pc()
+    pc.proposal_message = build_prepare(VALID_PROPOSAL_HASH, view0(), b"node-1")
+    assert not ibft._valid_pc(pc, 1, 0)
+
+    # prepare member not a PREPARE
+    pc = _pc()
+    pc.prepare_messages[0] = build_commit(VALID_PROPOSAL_HASH, view0(), b"node-2")
+    assert not ibft._valid_pc(pc, 1, 0)
+
+    # round >= roundLimit
+    assert not ibft._valid_pc(_pc(round_=1), round_limit=1, height=0)
+
+    # height mismatch
+    assert not ibft._valid_pc(_pc(height=9), 1, 0)
+
+    # duplicate sender
+    assert not ibft._valid_pc(_pc(preparers=(b"node-2", b"node-2")), 1, 0)
+
+    # proposal message not sent by the round's proposer
+    assert not ibft._valid_pc(_pc(proposer=b"node-2"), 1, 0)
+
+    # prepare message from the proposer (forbidden)
+    assert not ibft._valid_pc(_pc(preparers=(b"node-1", b"node-2")), 1, 0)
+
+    # invalid sender signature anywhere in the PC
+    backend.is_valid_validator_fn = lambda m: m.sender != b"node-3"
+    assert not ibft._valid_pc(_pc(), 1, 0)
+    ibft.messages.close()
+
+
+# -- proposal validation (reference ibft_test.go:2017) -----------------------
+
+
+def test_validate_proposal_round0():
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+
+    good = build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None, view0(), b"node-1")
+    assert ibft._validate_proposal_0(good, view0())
+
+    # proposal for a non-zero round
+    msg = build_preprepare(
+        VALID_BLOCK, VALID_PROPOSAL_HASH, None, View(height=0, round=1), b"node-1"
+    )
+    assert not ibft._validate_proposal_0(msg, view0())
+
+    # not from the proposer
+    msg = build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None, view0(), b"node-2")
+    assert not ibft._validate_proposal_0(msg, view0())
+
+    # bad proposal hash
+    msg = build_preprepare(VALID_BLOCK, b"junk", None, view0(), b"node-1")
+    assert not ibft._validate_proposal_0(msg, view0())
+
+    # invalid block body
+    msg = build_preprepare(b"junk block", VALID_PROPOSAL_HASH, None, view0(), b"node-1")
+    assert not ibft._validate_proposal_0(msg, view0())
+
+    # we are the proposer ourselves: reject
+    ibft2, _, _ = make_ibft(proposer=MY_ID)
+    msg = build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None, view0(), MY_ID)
+    assert not ibft2._validate_proposal_0(msg, view0())
+    ibft.messages.close()
+    ibft2.messages.close()
+
+
+def _rcc(senders, height=0, round_=1, with_pc=None):
+    msgs = [
+        build_round_change(None, with_pc, View(height=height, round=round_), s)
+        for s in senders
+    ]
+    return RoundChangeCertificate(round_change_messages=msgs)
+
+
+def test_validate_proposal_round1_rcc_rules():
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+    view1 = View(height=0, round=1)
+
+    def proposal_with(rcc):
+        return build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, rcc, view1, b"node-1")
+
+    # no RCC
+    assert not ibft._validate_proposal(proposal_with(None), view1)
+
+    # quorum RCC: 3 unique senders
+    assert ibft._validate_proposal(proposal_with(_rcc(ALL[1:])), view1)
+
+    # duplicate senders in RCC
+    assert not ibft._validate_proposal(
+        proposal_with(_rcc([b"node-1", b"node-1", b"node-2"])), view1
+    )
+
+    # not enough voting power in RCC
+    assert not ibft._validate_proposal(proposal_with(_rcc([b"node-1", b"node-2"])), view1)
+
+    # RCC member with wrong height
+    assert not ibft._validate_proposal(
+        proposal_with(_rcc(ALL[1:], height=5)), view1
+    )
+
+    # RCC member with wrong round
+    assert not ibft._validate_proposal(
+        proposal_with(_rcc(ALL[1:], round_=2)), view1
+    )
+
+    # RCC member failing signature validation
+    backend.is_valid_validator_fn = lambda m: m.sender != b"node-3"
+    assert not ibft._validate_proposal(proposal_with(_rcc(ALL[1:])), view1)
+    backend.is_valid_validator_fn = lambda m: True
+    ibft.messages.close()
+
+
+def test_validate_proposal_max_round_rule():
+    """The re-proposal must hash-match the PC of the highest prepared round
+    (reference ibft.go:740-788)."""
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+    view2 = View(height=0, round=2)
+
+    pc = _pc(round_=1)  # prepared at round 1 with VALID hash
+    rcc = _rcc(ALL[1:], round_=2, with_pc=pc)
+    # attach matching last-prepared proposal to RC messages
+    for m in rcc.round_change_messages:
+        m.round_change_data.last_prepared_proposal = Proposal(
+            raw_proposal=VALID_BLOCK, round=1
+        )
+
+    msg = build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, rcc, view2, b"node-1")
+    assert ibft._validate_proposal(msg, view2)
+
+    # same but the proposal's hash does not match the prepared certificate
+    backend.is_valid_proposal_hash_fn = (
+        lambda proposal, h: h == VALID_PROPOSAL_HASH and proposal.round != 1
+    )
+    assert not ibft._validate_proposal(msg, view2)
+    ibft.messages.close()
+
+
+# -- round-change certificate handling (reference ibft_test.go:2801) ---------
+
+
+def test_handle_round_change_message_builds_rcc():
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+    ibft.state.reset(0)
+
+    for sender in ALL[1:]:
+        ibft.add_message(
+            build_round_change(None, None, View(height=0, round=1), sender)
+        )
+    rcc = ibft._handle_round_change_message(view0())
+    assert rcc is not None
+    assert len(rcc.round_change_messages) == 3
+    assert all(m.view.round == 1 for m in rcc.round_change_messages)
+    ibft.messages.close()
+
+
+def test_handle_round_change_rejects_own_round_with_accepted_proposal():
+    ibft, backend, _ = make_ibft(proposer=b"node-1")
+    ibft.state.reset(0)
+    ibft.state.set_view(View(height=0, round=1))
+    ibft.state.set_proposal_message(
+        build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None,
+                         View(height=0, round=1), b"node-1")
+    )
+
+    for sender in ALL[1:]:
+        ibft.add_message(
+            build_round_change(None, None, View(height=0, round=1), sender)
+        )
+    # round == our round and we accepted a proposal -> no RCC
+    assert ibft._handle_round_change_message(View(height=0, round=1)) is None
+    ibft.messages.close()
+
+
+# -- RunSequence arbitration (reference ibft_test.go:2925,2986) --------------
+
+
+async def test_run_sequence_future_proposal_jump():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.set_base_round_timeout(5.0)
+
+    task = asyncio.create_task(ibft.run_sequence(0))
+    await asyncio.sleep(0.02)
+    # Inject a valid future-round proposal event directly (the reference
+    # preloads the newProposal channel, ibft_test.go:2925).
+    proposal = build_preprepare(
+        VALID_BLOCK, VALID_PROPOSAL_HASH, _rcc(ALL[1:], round_=2),
+        View(height=0, round=2), b"node-1",
+    )
+    ibft._signals.fire(
+        ibft._signals.new_proposal, _NewProposalEvent(proposal, 2)
+    )
+    await asyncio.sleep(0.05)
+
+    assert ibft.state.round == 2
+    assert ibft.state.proposal_message is not None
+    # prepare multicast upon the jump
+    assert any(m.type == MessageType.PREPARE for m in transport.sent)
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+async def test_run_sequence_rcc_jump():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.set_base_round_timeout(5.0)
+
+    task = asyncio.create_task(ibft.run_sequence(0))
+    await asyncio.sleep(0.02)
+    ibft._signals.fire(ibft._signals.round_certificate, 3)
+    await asyncio.sleep(0.05)
+
+    assert ibft.state.round == 3
+    assert ibft.state.name == StateName.NEW_ROUND
+
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    ibft.messages.close()
+
+
+# -- future proposal watcher (reference ibft_test.go:1328) -------------------
+
+
+async def test_watch_for_future_proposal_signals():
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.state.reset(0)
+
+    signals = _RoundSignals()
+    watcher = asyncio.create_task(ibft._watch_for_future_proposal(signals))
+    await asyncio.sleep(0.01)
+
+    proposal = build_preprepare(
+        VALID_BLOCK, VALID_PROPOSAL_HASH, _rcc(ALL[1:], round_=1),
+        View(height=0, round=1), b"node-1",
+    )
+    ibft.add_message(proposal)
+    await asyncio.sleep(0.05)
+
+    assert signals.new_proposal.done()
+    ev = signals.new_proposal.result()
+    assert ev.round == 1
+    assert ev.proposal_message.preprepare_data.proposal.raw_proposal == VALID_BLOCK
+
+    await asyncio.gather(watcher, return_exceptions=True)
+    ibft.messages.close()

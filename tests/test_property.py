@@ -1,0 +1,194 @@
+"""Property-based consensus tests.
+
+Ports the reference's core/rapid_test.go:153-388 (pgregory.net/rapid) onto
+hypothesis: random cluster sizes, heights, and per-(height, round) counts of
+silent vs actively-bad Byzantine nodes (always <= maxFaulty).  Each height
+must finalize once the generated round sequence reaches an honest proposer:
+at least quorum honest nodes insert the correct block, Byzantine nodes insert
+nothing.
+"""
+
+import asyncio
+from dataclasses import dataclass, field
+
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from tests.harness import (
+    VALID_BLOCK,
+    VALID_PROPOSAL_HASH,
+    Cluster,
+    build_commit,
+    build_preprepare,
+    build_prepare,
+    max_faulty,
+    quorum_size,
+)
+
+BAD_BLOCK = b"bad block"
+BAD_HASH = b"bad hash"
+
+
+@dataclass
+class RoundEvent:
+    """Byzantine population for one (height, round): the first ``silent``
+    node indices say nothing; the next ``bad`` indices push bad messages."""
+
+    silent: int
+    bad: int
+
+    @property
+    def byzantine(self) -> int:
+        return self.silent + self.bad
+
+    def is_silent(self, idx: int) -> bool:
+        return idx < self.silent
+
+    def is_byzantine(self, idx: int) -> bool:
+        """Silent nodes also judge messages against the bad message, so no
+        byzantine node ever inserts (reference rapid_test.go:84-92)."""
+        return idx < self.byzantine
+
+
+@dataclass
+class Setup:
+    """Generated schedule (reference rapid_test.go:153-202)."""
+
+    nodes: int
+    events: list[list[RoundEvent]] = field(default_factory=list)  # [height][round]
+
+    def event(self, height: int, round_: int) -> RoundEvent:
+        rounds = self.events[height]
+        return rounds[min(round_, len(rounds) - 1)]
+
+
+@st.composite
+def setups(draw) -> Setup:
+    num_nodes = draw(st.integers(min_value=4, max_value=10))
+    desired_height = draw(st.integers(min_value=1, max_value=3))
+    f = max_faulty(num_nodes)
+
+    setup = Setup(nodes=num_nodes)
+    for height in range(desired_height):
+        rounds: list[RoundEvent] = []
+        round_ = 0
+        while True:
+            byz = draw(st.integers(min_value=0, max_value=f))
+            silent = draw(st.integers(min_value=0, max_value=byz))
+            rounds.append(RoundEvent(silent=silent, bad=byz - silent))
+            proposer_idx = (height + round_) % num_nodes
+            if proposer_idx >= byz:
+                break  # honest proposer: this round should finalize
+            round_ += 1
+            if round_ > 3:  # keep wall-clock bounded; exponential timeouts
+                rounds[-1] = RoundEvent(silent=0, bad=0)
+                break
+        setup.events.append(rounds)
+    return setup
+
+
+def _wire_cluster(cluster: Cluster, setup: Setup, height: int) -> None:
+    """Install the per-node behavior delegates for one height."""
+    node_round = {idx: 0 for idx in range(setup.nodes)}
+
+    for idx, node in enumerate(cluster.nodes):
+        def make(idx, node):
+            def current_event() -> RoundEvent:
+                return setup.event(height, node_round[idx])
+
+            def my_block() -> bytes:
+                return BAD_BLOCK if current_event().is_byzantine(idx) else VALID_BLOCK
+
+            def my_hash() -> bytes:
+                return (
+                    BAD_HASH
+                    if current_event().is_byzantine(idx)
+                    else VALID_PROPOSAL_HASH
+                )
+
+            # Transport wrapper: track rounds, silence silent nodes
+            # (reference rapid_test.go:220-236).
+            def multicast(message):
+                from go_ibft_tpu.messages import MessageType
+
+                if message.type == MessageType.ROUND_CHANGE and message.view:
+                    node_round[idx] = message.view.round
+                if current_event().is_silent(idx):
+                    return
+                cluster.gossip(node, message)
+
+            class _T:
+                def __init__(self):
+                    self.multicast = multicast
+
+            node.core.transport = _T()
+
+            # Validity functions judge against the node's own notion of the
+            # correct message (bad nodes reject honest proposals and thus
+            # never insert; reference rapid_test.go:255-266).
+            node.backend.is_valid_proposal_fn = lambda raw: raw == my_block()
+            node.backend.is_valid_proposal_hash_fn = (
+                lambda proposal, h: proposal.raw_proposal == my_block()
+                and h == my_hash()
+            )
+            node.backend.build_proposal_fn = lambda view: my_block()
+            node.backend.build_preprepare_fn = (
+                lambda raw, _hash, cert, view, sender: build_preprepare(
+                    raw, my_hash(), cert, view, sender
+                )
+            )
+            node.backend.build_prepare_fn = (
+                lambda _hash, view, sender: build_prepare(my_hash(), view, sender)
+            )
+            node.backend.build_commit_fn = (
+                lambda _hash, view, sender: build_commit(my_hash(), view, sender)
+            )
+
+        make(idx, node)
+
+
+@settings(
+    max_examples=6,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(setup=setups())
+def test_property_consensus(setup: Setup):
+    async def run() -> None:
+        cluster = Cluster(setup.nodes)
+        cluster.set_base_timeout(0.1)
+        try:
+            for height in range(len(setup.events)):
+                _wire_cluster(cluster, setup, height)
+                before = [len(n.inserted_blocks) for n in cluster.nodes]
+
+                rounds = len(setup.events[height])
+                timeout = 0.2 * (2 ** (rounds * 2)) + 5.0
+                completed = await cluster.run_height_quorum(
+                    height, quorum_size(setup.nodes), timeout=timeout
+                )
+                assert completed >= quorum_size(setup.nodes), (
+                    f"height {height}: only {completed} nodes completed"
+                )
+
+                last_event = setup.events[height][-1]
+                inserted_count = 0
+                for idx, node in enumerate(cluster.nodes):
+                    new = node.inserted_blocks[before[idx]:]
+                    if idx >= last_event.byzantine:
+                        # honest in the deciding round: at most one insertion,
+                        # and it must be the correct block
+                        assert len(new) <= 1
+                        for proposal, _seals in new:
+                            assert proposal.raw_proposal == VALID_BLOCK
+                        inserted_count += len(new)
+                    else:
+                        # byzantine nodes must not insert anything
+                        assert new == [], f"byzantine node {idx} inserted {new}"
+
+                assert inserted_count >= quorum_size(setup.nodes) - last_event.byzantine
+
+        finally:
+            cluster.shutdown()
+
+    asyncio.run(run())

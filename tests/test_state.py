@@ -1,0 +1,89 @@
+"""SequenceState tests (reference core/state.go semantics)."""
+
+from go_ibft_tpu.core import SequenceState, StateName
+from go_ibft_tpu.messages import (
+    IbftMessage,
+    MessageType,
+    PreparedCertificate,
+    PrePrepareMessage,
+    Proposal,
+    View,
+)
+from go_ibft_tpu.messages.helpers import CommittedSeal
+
+
+def _proposal_msg(raw=b"block", hash_=b"h", round_=0):
+    return IbftMessage(
+        view=View(height=1, round=round_),
+        sender=b"p",
+        type=MessageType.PREPREPARE,
+        preprepare_data=PrePrepareMessage(
+            proposal=Proposal(raw_proposal=raw, round=round_), proposal_hash=hash_
+        ),
+    )
+
+
+def test_reset_wipes_everything():
+    st = SequenceState()
+    st.set_proposal_message(_proposal_msg())
+    st.set_committed_seals([CommittedSeal(b"a", b"s")])
+    st.finalize_prepare(PreparedCertificate(), Proposal())
+    st.set_round_started(True)
+
+    st.reset(7)
+    assert st.view == View(height=7, round=0)
+    assert st.proposal_message is None
+    assert st.latest_pc is None
+    assert st.latest_prepared_proposal is None
+    assert st.committed_seals == []
+    assert not st.round_started
+    assert st.name == StateName.NEW_ROUND
+
+
+def test_new_round_idempotent():
+    st = SequenceState()
+    st.change_state(StateName.COMMIT)
+    st.new_round()  # not started: kicks off
+    assert st.name == StateName.NEW_ROUND
+    assert st.round_started
+
+    st.change_state(StateName.PREPARE)
+    st.new_round()  # already started: no-op
+    assert st.name == StateName.PREPARE
+
+
+def test_finalize_prepare_moves_to_commit():
+    st = SequenceState()
+    pc = PreparedCertificate(proposal_message=_proposal_msg())
+    prop = Proposal(raw_proposal=b"block", round=0)
+    st.finalize_prepare(pc, prop)
+    assert st.name == StateName.COMMIT
+    assert st.latest_pc == pc
+    assert st.latest_prepared_proposal == prop
+
+
+def test_proposal_accessors():
+    st = SequenceState()
+    assert st.proposal is None
+    assert st.proposal_hash is None
+    assert st.raw_proposal is None
+
+    st.set_proposal_message(_proposal_msg(raw=b"RAW", hash_=b"HH"))
+    assert st.proposal.raw_proposal == b"RAW"
+    assert st.proposal_hash == b"HH"
+    assert st.raw_proposal == b"RAW"
+
+
+def test_view_returns_copy():
+    st = SequenceState()
+    st.reset(3)
+    view = st.view
+    view.round = 99
+    assert st.round == 0
+
+
+def test_state_name_str():
+    assert str(StateName.NEW_ROUND) == "new round"
+    assert str(StateName.PREPARE) == "prepare"
+    assert str(StateName.COMMIT) == "commit"
+    assert str(StateName.FIN) == "fin"

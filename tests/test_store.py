@@ -162,11 +162,38 @@ def test_get_most_round_change_round_zero_not_found():
 def test_remove_messages_batch_prune():
     store = MessageStore()
     view = View(height=1, round=0)
+    msgs = {}
     for sender in (b"a", b"b", b"c", b"d"):
-        store.add_message(_msg(MessageType.COMMIT, 1, 0, sender))
-    store.remove_messages(view, MessageType.COMMIT, [b"b", b"d", b"ghost"])
+        msgs[sender] = _msg(MessageType.COMMIT, 1, 0, sender)
+        store.add_message(msgs[sender])
+    ghost = _msg(MessageType.COMMIT, 1, 0, b"ghost")
+    store.remove_messages(view, MessageType.COMMIT, [msgs[b"b"], msgs[b"d"], ghost])
     left = store.snapshot_view(view, MessageType.COMMIT)
     assert sorted(m.sender for m in left) == [b"a", b"c"]
+    store.close()
+
+
+def test_remove_messages_spares_replaced_message():
+    # A sender may replace its message during the unlocked verify window;
+    # removal is by identity so the replacement survives.
+    store = MessageStore()
+    view = View(height=1, round=0)
+    old = _msg(MessageType.COMMIT, 1, 0, b"s")
+    store.add_message(old)
+    snapshot = store.snapshot_view(view, MessageType.COMMIT)
+    replacement = _msg(MessageType.COMMIT, 1, 0, b"s", )
+    store.add_message(replacement)
+    store.remove_messages(view, MessageType.COMMIT, snapshot)
+    left = store.snapshot_view(view, MessageType.COMMIT)
+    assert left == [replacement] and left[0] is replacement
+    store.close()
+
+
+def test_add_message_unknown_type_ignored():
+    from go_ibft_tpu.messages import IbftMessage, View as V
+    store = MessageStore()
+    foreign = IbftMessage(view=V(height=1, round=0), sender=b"x", type=9)
+    store.add_message(foreign)  # must not raise
     store.close()
 
 

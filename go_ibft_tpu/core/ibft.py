@@ -910,6 +910,11 @@ class IBFT:
     def _gate_height_round(self, message: IbftMessage) -> bool:
         if message.view is None:
             return False
+        # Unknown open-enum types preserved by the wire codec are not
+        # consensus messages: reject at the ingress gate so the signal path
+        # never consults the store with a type it has no key for.
+        if not isinstance(message.type, MessageType):
+            return False
         state_height = self.state.height
         if state_height > message.view.height:
             return False

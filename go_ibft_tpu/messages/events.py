@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -125,6 +126,11 @@ class EventManager:
     def __init__(self) -> None:
         self._subscriptions: dict[int, Subscription] = {}
         self._ids = itertools.count(1)
+        # add_message arrives from embedder network threads while the engine
+        # loop subscribes/unsubscribes; guard the registry like the store
+        # guards its maps (reference relies on Go's per-field mutexes,
+        # messages/event_manager.go:16,66,87).
+        self._lock = threading.Lock()
 
     @property
     def num_subscriptions(self) -> int:
@@ -133,24 +139,28 @@ class EventManager:
     def subscribe(self, details: SubscriptionDetails) -> Subscription:
         """Register a listener (reference messages/event_manager.go:61-83)."""
         sub = Subscription(id=next(self._ids), details=details)
-        self._subscriptions[sub.id] = sub
+        with self._lock:
+            self._subscriptions[sub.id] = sub
         return sub
 
     def cancel_subscription(self, sub_id: int) -> None:
         """Stop one subscription (reference messages/event_manager.go:86-95)."""
-        sub = self._subscriptions.pop(sub_id, None)
+        with self._lock:
+            sub = self._subscriptions.pop(sub_id, None)
         if sub is not None:
             sub.close()
 
     def close(self) -> None:
         """Cancel all subscriptions (reference messages/event_manager.go:98-107)."""
-        for sub in self._subscriptions.values():
+        with self._lock:
+            subs = list(self._subscriptions.values())
+            self._subscriptions.clear()
+        for sub in subs:
             sub.close()
-        self._subscriptions.clear()
 
     def signal_event(self, message_type: MessageType, view: View) -> None:
         """Alert all matching listeners (reference messages/event_manager.go:110-129)."""
-        if not self._subscriptions:
-            return
-        for sub in list(self._subscriptions.values()):
+        with self._lock:
+            subs = list(self._subscriptions.values())
+        for sub in subs:
             sub.push_event(message_type, view)

@@ -1,0 +1,9 @@
+"""TPU-native batched math ops: limbed big-integer fields, elliptic curves,
+Keccak hashing.
+
+This package is the data plane of the framework — the reference's expensive
+per-message ``Verifier`` predicates (go-ibft core/backend.go:37-56, driven
+one message at a time under the store lock in messages/messages.go:183-198)
+become fixed-shape, ``jit``/``vmap``-compiled batch kernels here
+(SURVEY.md §7 stage 4).
+"""

@@ -1,0 +1,342 @@
+"""Limbed modular big-integer arithmetic on TPU (JAX/XLA).
+
+TPUs have no native wide-integer types, so 256-bit field elements are
+represented as vectors of radix-2**13 limbs held in ``int32`` lanes
+(SURVEY.md §7 hard part (a)).  The radix is chosen so that schoolbook
+multiplication never overflows int32:
+
+* a limb product is < 2**26,
+* a convolution column sums at most ``nlimbs`` (= 20 for 256-bit fields)
+  such products, staying < 20 * 2**26 < 2**31.
+
+All values are kept **unsigned and "semi-reduced"**: limbs lie in
+``[0, 2**13]`` (the upper bound is *inclusive* — lazy carries may leave a
+limb at exactly 2**13, which the overflow analysis above still admits) and
+the represented value lies in ``[0, 2*p)``.  Subtraction never produces
+negative limbs: ``a - b`` is computed as ``a + F - b`` where ``F`` is a
+precomputed *fat* representation of ``K*p`` whose every limb is >= 2**13.
+Exact canonicalization to ``[0, p)`` (sequential carry scans) happens only
+at the edges — final comparisons and host I/O — never inside hot loops.
+
+Reduction uses generalized pseudo-Mersenne folding: ``2**(13*L) === c_fold``
+and ``2**bits === c_fb (mod p)``, with the fold schedule derived statically
+from value bounds at :class:`Modulus` construction time.  This requires the
+modulus to sit close under a power of two (``128 * c_fb < p``) — true for
+both secp256k1 moduli; BLS12-381 uses the Montgomery path instead (see
+``bls12_381.py``).
+
+Everything here is shape-static, branch-free, and batched by broadcasting
+over leading axes, so a whole round's worth of signatures reduces in one
+``jit`` — replacing the reference's per-message sequential verifies
+(go-ibft messages/messages.go:183-198, core/backend.go:37-56).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+__all__ = [
+    "LIMB_BITS",
+    "LIMB_MASK",
+    "Modulus",
+    "to_limbs",
+    "from_limbs",
+    "add",
+    "sub",
+    "mul",
+    "sqr",
+    "muli",
+    "pow_fixed",
+    "inv",
+    "canon",
+    "is_zero",
+    "eq_mod",
+    "select",
+]
+
+
+def to_limbs(values: Sequence[int], nlimbs: int) -> np.ndarray:
+    """Host-side: pack python ints into an ``(N, nlimbs)`` int32 limb array."""
+    out = np.zeros((len(values), nlimbs), dtype=np.int32)
+    for row, v in enumerate(values):
+        if v < 0:
+            raise ValueError("limb representation is unsigned")
+        for i in range(nlimbs):
+            out[row, i] = v & LIMB_MASK
+            v >>= LIMB_BITS
+        if v:
+            raise ValueError(f"value does not fit in {nlimbs} limbs")
+    return out
+
+
+def from_limbs(arr) -> List[int]:
+    """Host-side: unpack an ``(..., nlimbs)`` limb array into python ints."""
+    a = np.asarray(arr, dtype=np.int64)
+    flat = a.reshape(-1, a.shape[-1])
+    out = []
+    for row in flat:
+        v = 0
+        for i in range(a.shape[-1] - 1, -1, -1):
+            v = (v << LIMB_BITS) + int(row[i])
+        out.append(v)
+    return out
+
+
+def _single_limbs(value: int, nlimbs: int) -> np.ndarray:
+    return to_limbs([value], nlimbs)[0]
+
+
+def _fat_rep(value: int, nlimbs: int) -> np.ndarray:
+    """Limb rep of ``value`` with every limb in [2**13, 3 * 2**13).
+
+    Adding it makes limb-wise subtraction borrow-free.  Exists whenever
+    ``value`` >= sum(2**13 * 2**(13 i)) and fits the per-limb cap.
+    """
+    lo_floor = LIMB_MASK + 1
+    cap = 3 * lo_floor - 1
+    rem = value - sum(lo_floor << (LIMB_BITS * i) for i in range(nlimbs))
+    if rem < 0:
+        raise ValueError("value too small for fat representation")
+    out = np.zeros(nlimbs, dtype=np.int32)
+    for i in range(nlimbs - 1, -1, -1):
+        unit = 1 << (LIMB_BITS * i)
+        extra = min(rem // unit, cap - lo_floor)
+        out[i] = lo_floor + extra
+        rem -= extra * unit
+    if rem:
+        raise ValueError("fat representation infeasible")
+    return out
+
+
+class Modulus:
+    """Static per-modulus data: limbs, fold constants, fat K*p rep.
+
+    Construction precomputes everything the traced ops need as numpy
+    constants, so a ``Modulus`` instance can be closed over inside ``jit``.
+    """
+
+    def __init__(self, p: int):
+        if p <= 0:
+            raise ValueError("modulus must be positive")
+        self.p = p
+        self.bits = p.bit_length()
+        self.nlimbs = -(-self.bits // LIMB_BITS)
+        L = self.nlimbs
+        self.limbs = _single_limbs(p, L)
+        # Fold constants: 2**(13 L) and 2**bits mod p.
+        self.c_fold = (1 << (LIMB_BITS * L)) % p
+        self.c_fb = (1 << self.bits) % p  # == 2**bits - p
+        if self.c_fb == 0 or 128 * self.c_fb >= p:
+            raise ValueError(
+                "modulus too far below a power of two for folding; "
+                "use the Montgomery path"
+            )
+        self.c_fold_limbs = _single_limbs(self.c_fold, -(-self.c_fold.bit_length() // LIMB_BITS))
+        self.c_fb_limbs = _single_limbs(self.c_fb, -(-max(self.c_fb.bit_length(), 1) // LIMB_BITS))
+        self.fb_limb, self.fb_shift = divmod(self.bits, LIMB_BITS)
+        # Fat K*p for borrow-free subtraction of any semi-reduced (< 2p) value.
+        k = 3
+        while k * p < sum((LIMB_MASK + 1) << (LIMB_BITS * i) for i in range(L)):
+            k += 1
+        self.fat_kp = _fat_rep(k * p, L)
+        self.fat_k = k
+        self.sub_bound = 2 * p + k * p  # value bound of a + K p - b
+
+    def const(self, value: int) -> np.ndarray:
+        """Limbs of ``value mod p`` as a broadcastable ``(nlimbs,)`` array."""
+        return _single_limbs(value % self.p, self.nlimbs)
+
+
+def _carry(z: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Lazy parallel carry: each pass moves carries one limb up.
+
+    With unsigned inputs bounded < 2**31 the limb values converge to
+    ``[0, 2**13]`` in <= 4 passes (see module docstring).  The caller must
+    size ``z`` so the top limb never produces a carry.
+    """
+    zero = jnp.zeros(z.shape[:-1] + (1,), dtype=z.dtype)
+    for _ in range(passes):
+        c = z >> LIMB_BITS
+        z = (z & LIMB_MASK) + jnp.concatenate([zero, c[..., :-1]], axis=-1)
+    return z
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """Schoolbook product as a sum of shifted partials; no carries applied."""
+    la, lb = a.shape[-1], b.shape[-1]
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros(batch + (out_len,), dtype=jnp.int32)
+    for i in range(la):
+        term = a[..., i : i + 1] * b
+        pad = [(0, 0)] * (len(batch)) + [(i, out_len - i - lb)]
+        acc = acc + jnp.pad(jnp.broadcast_to(term, batch + (lb,)), pad)
+    return acc
+
+
+def _pad_to(z: jnp.ndarray, n: int) -> jnp.ndarray:
+    if z.shape[-1] >= n:
+        return z
+    pad = [(0, 0)] * (z.ndim - 1) + [(0, n - z.shape[-1])]
+    return jnp.pad(z, pad)
+
+
+def _fold_semi(m: Modulus, z: jnp.ndarray, bound: int) -> jnp.ndarray:
+    """Reduce a carried, unsigned limb vector of known value ``bound`` to a
+    semi-reduced (< 2p) ``nlimbs`` vector.  Fold schedule is static."""
+    L = m.nlimbs
+    lw = LIMB_BITS * L
+    c_fold = jnp.asarray(m.c_fold_limbs)
+    while bound >= (1 << (lw + 6)):
+        lo, hi = z[..., :L], z[..., L:]
+        hi_bound = (bound >> lw) + 1
+        # Truncate provably-zero top limbs of hi (unsigned => value-bounded).
+        hi_len = min(hi.shape[-1], -(-hi_bound.bit_length() // LIMB_BITS) + 1)
+        hi = hi[..., :hi_len]
+        prod_bound = hi_bound * m.c_fold
+        out_len = max(L, hi_len + c_fold.shape[-1]) + 1
+        prod = _carry(_conv(hi, c_fold, out_len), 4)
+        z = _carry(_pad_to(lo, out_len) + prod, 2)
+        bound = (1 << lw) + prod_bound
+    # Final fold at bit position m.bits: v = lo + hi * 2**bits === lo + hi*c_fb.
+    z = _pad_to(z, L + 2)[..., : L + 2]
+    fbl, fbs = m.fb_limb, m.fb_shift
+    hi = z[..., fbl] >> fbs
+    for j in range(fbl + 1, z.shape[-1]):
+        hi = hi + (z[..., j] << (LIMB_BITS * (j - fbl) - fbs))
+    lo = z[..., :L]
+    mask_col = jnp.asarray(
+        [(1 << fbs) - 1 if i == fbl else LIMB_MASK + 1 for i in range(L)],
+        dtype=jnp.int32,
+    )
+    # (the +1 sentinel leaves limbs below fbl untouched: x & (2**13) is wrong —
+    #  so use a where instead of a mask for clarity)
+    keep = jnp.asarray([i < fbl for i in range(L)])
+    lo = jnp.where(keep, lo, lo & mask_col)
+    cf = jnp.asarray(m.c_fb_limbs)
+    prod = hi[..., None] * cf  # hi < 2**7, limb < 2**13 -> < 2**20, int32-safe
+    return _carry(lo + _pad_to(prod, L), 3)
+
+
+def add(m: Modulus, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod-class, semi-reduced output."""
+    z = _carry(_pad_to(a + b, m.nlimbs + 1), 2)
+    return _fold_semi(m, z, 4 * m.p)
+
+
+def sub(m: Modulus, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod-class via the borrow-free fat K*p trick."""
+    z = _carry(_pad_to(a + jnp.asarray(m.fat_kp) - b, m.nlimbs + 1), 3)
+    return _fold_semi(m, z, m.sub_bound)
+
+
+def mul(m: Modulus, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a * b) mod-class: schoolbook conv + static fold schedule."""
+    bound = (2 * m.p) ** 2
+    out_len = -(-bound.bit_length() // LIMB_BITS) + 1
+    z = _carry(_conv(a, b, out_len), 4)
+    return _fold_semi(m, z, bound)
+
+
+def sqr(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    return mul(m, a, a)
+
+
+def muli(m: Modulus, a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant 1 <= k <= 16."""
+    if not 1 <= k <= 16:
+        raise ValueError("k out of range")
+    z = _carry(_pad_to(a * k, m.nlimbs + 2), 3)
+    return _fold_semi(m, z, 2 * m.p * k)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless limb-vector select; ``cond`` broadcasts over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_fixed(m: Modulus, a: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """a**exponent with a fixed public exponent, via an MSB-first scan."""
+    if exponent < 0:
+        raise ValueError("exponent must be non-negative")
+    if exponent == 0:
+        return jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        [(exponent >> i) & 1 for i in range(nbits - 2, -1, -1)], dtype=bool
+    )
+
+    def body(acc, bit):
+        acc = mul(m, acc, acc)
+        acc = select(jnp.broadcast_to(bit, acc.shape[:-1]), mul(m, acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, a, bits)
+    return acc
+
+
+def inv(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """Modular inverse by Fermat (modulus must be prime); inv(0) == 0."""
+    return pow_fixed(m, a, m.p - 2)
+
+
+def _exact_carry(z: jnp.ndarray) -> jnp.ndarray:
+    """Sequential exact carry propagation (lax.scan over the limb axis)."""
+
+    def step(carry, x):
+        t = x + carry
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    xs = jnp.moveaxis(z, -1, 0)
+    _, ys = jax.lax.scan(step, jnp.zeros(z.shape[:-1], dtype=z.dtype), xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def _ge_const(a: jnp.ndarray, ref: np.ndarray) -> jnp.ndarray:
+    """a >= ref, both canonical; unrolled lexicographic compare from the top."""
+    res = jnp.zeros(a.shape[:-1], dtype=jnp.int32)
+    for i in range(a.shape[-1] - 1, -1, -1):
+        d = jnp.sign(a[..., i] - int(ref[i]))
+        res = jnp.where(res != 0, res, d)
+    return res >= 0
+
+
+def canon(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical form in [0, p) with limbs < 2**13.
+
+    Input must be semi-reduced (< 2p).  Only used at the edges (final
+    equality checks, host I/O): it contains sequential limb scans, which
+    would serialize the hot loop.
+    """
+    z = _exact_carry(a)
+    ge = _ge_const(z, m.limbs)
+    # _sub_exact is only meaningful where z >= p (no final borrow); the
+    # other lanes keep z.
+    return select(ge, _sub_exact(z, m.limbs), z)
+
+
+def _sub_exact(a: jnp.ndarray, ref: np.ndarray) -> jnp.ndarray:
+    """a - ref for canonical a >= ref: sequential borrow scan."""
+
+    def step(borrow, x):
+        t = x + borrow
+        b = t >> LIMB_BITS
+        return b, t - (b << LIMB_BITS)
+
+    xs = jnp.moveaxis(a - jnp.asarray(ref, dtype=jnp.int32), -1, 0)
+    _, ys = jax.lax.scan(step, jnp.zeros(a.shape[:-1], dtype=a.dtype), xs)
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def is_zero(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(m, a) == 0, axis=-1)
+
+
+def eq_mod(m: Modulus, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(m, sub(m, a, b))

@@ -10,12 +10,18 @@ to export to Prometheus or anything else.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Callable, Optional, Sequence
+
+# Bounded so a long-running validator (many samples per round, forever)
+# cannot leak memory; scrapers wanting full fidelity attach a sink.
+_HISTOGRAM_WINDOW = 4096
 
 _lock = threading.Lock()
 _gauges: dict[tuple[str, ...], float] = {}
-_histograms: dict[tuple[str, ...], list[float]] = defaultdict(list)
+_histograms: dict[tuple[str, ...], deque[float]] = defaultdict(
+    lambda: deque(maxlen=_HISTOGRAM_WINDOW)
+)
 _sink: Optional[Callable[[str, tuple[str, ...], float], None]] = None
 
 

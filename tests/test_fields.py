@@ -1,0 +1,145 @@
+"""Oracle tests for the limbed modular arithmetic (ops/fields.py).
+
+Every op is checked against python-int arithmetic over both secp256k1
+moduli (field prime and group order) on randomized batches, including
+adversarial boundary values (0, 1, p-1, p, 2p-1 pre-reduction classes).
+Ops are exercised under ``jax.jit`` — the only way they run in production.
+"""
+
+import random
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_ibft_tpu.ops import fields as F
+
+P_SECP = 2**256 - 2**32 - 977
+N_SECP = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+MODULI = [pytest.param(P_SECP, id="p"), pytest.param(N_SECP, id="n")]
+
+_CACHE = {}
+
+
+def _ops(p):
+    """Modulus + jitted ops, cached so each jit compiles once per session."""
+    if p not in _CACHE:
+        m = F.Modulus(p)
+        _CACHE[p] = {
+            "m": m,
+            "add": jax.jit(partial(F.add, m)),
+            "sub": jax.jit(partial(F.sub, m)),
+            "mul": jax.jit(partial(F.mul, m)),
+            "canon": jax.jit(partial(F.canon, m)),
+            "inv": jax.jit(partial(F.inv, m)),
+            "is_zero": jax.jit(partial(F.is_zero, m)),
+            "eq_mod": jax.jit(partial(F.eq_mod, m)),
+            "muli": {k: jax.jit(partial(F.muli, m, k=k)) for k in (1, 2, 3, 8, 16)},
+        }
+    return _CACHE[p]
+
+
+def _samples(p, rng, count=32):
+    edge = [0, 1, 2, p - 1, p - 2, 2**255, 2 * p - 1]
+    vals = edge + [rng.randrange(2 * p) for _ in range(count - len(edge))]
+    return [v % (2 * p) for v in vals]
+
+
+@pytest.mark.parametrize("p", MODULI)
+def test_roundtrip(p):
+    m = _ops(p)["m"]
+    rng = random.Random(1)
+    vals = _samples(p, rng)
+    limbs = F.to_limbs(vals, m.nlimbs)
+    assert F.from_limbs(limbs) == vals
+
+
+@pytest.mark.parametrize("p", MODULI)
+def test_add_sub_mul(p):
+    ops = _ops(p)
+    m = ops["m"]
+    rng = random.Random(2)
+    a_int = _samples(p, rng)
+    b_int = list(reversed(_samples(p, rng)))
+    a = jnp.asarray(F.to_limbs(a_int, m.nlimbs))
+    b = jnp.asarray(F.to_limbs(b_int, m.nlimbs))
+    for name, ref in [
+        ("add", lambda x, y: (x + y) % p),
+        ("sub", lambda x, y: (x - y) % p),
+        ("mul", lambda x, y: (x * y) % p),
+    ]:
+        out = ops[name](a, b)
+        # semi-reduced invariant: limbs in [0, 2**13], value < 2p
+        arr = np.asarray(out)
+        assert arr.min() >= 0 and arr.max() <= 1 << F.LIMB_BITS
+        got = F.from_limbs(ops["canon"](out))
+        want = [ref(x, y) for x, y in zip(a_int, b_int)]
+        assert got == want, name
+
+
+@pytest.mark.parametrize("p", MODULI)
+def test_muli(p):
+    ops = _ops(p)
+    m = ops["m"]
+    rng = random.Random(3)
+    a_int = _samples(p, rng)
+    a = jnp.asarray(F.to_limbs(a_int, m.nlimbs))
+    for k, fn in ops["muli"].items():
+        got = F.from_limbs(ops["canon"](fn(a)))
+        assert got == [(x * k) % p for x in a_int]
+
+
+@pytest.mark.parametrize("p", MODULI)
+def test_pow_inv(p):
+    ops = _ops(p)
+    m = ops["m"]
+    rng = random.Random(4)
+    a_int = _samples(p, rng, 12)
+    a = jnp.asarray(F.to_limbs(a_int, m.nlimbs))
+    e = rng.randrange(1, p)
+    got = F.from_limbs(ops["canon"](jax.jit(partial(F.pow_fixed, m, exponent=e))(a)))
+    assert got == [pow(x, e, p) for x in a_int]
+    inv = F.from_limbs(ops["canon"](ops["inv"](a)))
+    assert inv == [pow(x, p - 2, p) for x in a_int]
+
+
+@pytest.mark.parametrize("p", MODULI)
+def test_predicates(p):
+    ops = _ops(p)
+    m = ops["m"]
+    vals = [0, p, 1, p - 1, p + 1]  # semi-reduced representatives
+    a = jnp.asarray(F.to_limbs(vals, m.nlimbs))
+    assert list(np.asarray(ops["is_zero"](a))) == [True, True, False, False, False]
+    b = jnp.asarray(F.to_limbs([p, 0, p + 1, p - 1, 1], m.nlimbs))
+    assert list(np.asarray(ops["eq_mod"](a, b))) == [True] * 5
+
+
+def test_chained_ops_stay_semi_reduced():
+    """Long dependency chains must preserve the invariant (lazy carries)."""
+    p = P_SECP
+    m = _ops(p)["m"]
+    rng = random.Random(5)
+    a_int = [rng.randrange(p) for _ in range(8)]
+    a = jnp.asarray(F.to_limbs(a_int, m.nlimbs))
+
+    @jax.jit
+    def chain(a):
+        acc = a
+        for i in range(25):
+            acc = F.mul(m, acc, a)
+            acc = F.sub(m, acc, a) if i % 2 else F.add(m, acc, a)
+        return acc
+
+    acc_int = a_int[:]
+    for i in range(25):
+        acc_int = [
+            ((x * y) + (y if i % 2 == 0 else -y)) % p
+            for x, y in zip(acc_int, a_int)
+        ]
+    out = chain(a)
+    arr = np.asarray(out)
+    assert arr.min() >= 0 and arr.max() <= 1 << F.LIMB_BITS
+    assert F.from_limbs(_ops(p)["canon"](out)) == acc_int

@@ -1,0 +1,141 @@
+"""Headline benchmark: 100-validator PREPARE+COMMIT quorum verification.
+
+BASELINE.md config #2 — the north-star metric.  One IBFT round at 100
+validators produces 100 PREPARE envelopes and 100 COMMIT seals; the device
+must certify both phases (signature recovery, sender identity, validator
+membership, voting-power quorum) end-to-end.  Baseline denominator is the
+sequential per-message host verify loop — the shape of the reference's
+GetValidMessages/Verifier path (go-ibft messages/messages.go:183-198).
+
+Prints ONE JSON line: {"metric", "value" (p50 ms), "unit", "vs_baseline"}.
+"""
+
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_VALIDATORS = 100
+REPS = 30
+
+
+def main() -> None:
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+
+    w = build_round_workload(N_VALIDATORS)
+    blocks, counts, r, s, v, senders, live = w.prepare
+    prep_args = (
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+    hz, sr, ss_, sv, signers, slive = w.seals
+    seal_args = (
+        jnp.asarray(hz),
+        jnp.asarray(sr),
+        jnp.asarray(ss_),
+        jnp.asarray(sv),
+        jnp.asarray(signers),
+        jnp.asarray(w.table),
+        jnp.asarray(slive),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+
+    # warmup / compile + correctness gate
+    mask, reached, _, _ = quorum_certify(*prep_args)
+    smask, sreached, _, _ = seal_quorum_certify(*seal_args)
+    assert np.asarray(mask)[:N_VALIDATORS].all() and bool(np.asarray(reached))
+    assert np.asarray(smask)[:N_VALIDATORS].all() and bool(np.asarray(sreached))
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        m1 = quorum_certify(*prep_args)
+        m2 = seal_quorum_certify(*seal_args)
+        jax.block_until_ready((m1, m2))
+        times.append((time.perf_counter() - t0) * 1e3)
+    p50 = statistics.median(times)
+
+    # Baseline denominator: the native C++ sequential per-message loop —
+    # the reference embedder's Go crypto/ecdsa shape (one recover + address
+    # + membership per message, messages/messages.go:183-198).  Falls back
+    # to the pure-Python loop when no compiler exists.
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.crypto import keccak256
+    from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+    from go_ibft_tpu.messages.helpers import extract_committed_seal
+    from go_ibft_tpu.messages.wire import Proposal, View
+
+    keys = _keys(N_VALIDATORS, 0)
+    powers = {k.address: 1 for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=1, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"bench block 1", round=0))
+    prepares = [b.build_prepare_message(phash, view) for b in backends]
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    table = [k.address for k in keys]
+
+    from go_ibft_tpu import native
+
+    if native.load() is not None:
+        digests = [
+            keccak256(m.encode(include_signature=False)) for m in prepares
+        ] + [phash] * len(seals)
+        sigs = [m.signature for m in prepares] + [s.signature for s in seals]
+        claimed = [m.sender for m in prepares] + [s.signer for s in seals]
+        reps = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            hm = native.verify_batch_sequential(digests, sigs, claimed, table)
+            reps.append((time.perf_counter() - t0) * 1e3)
+        host_ms = statistics.median(reps)
+        baseline_name = "native C++ sequential per-message verify"
+        assert hm.all()
+    else:
+        from go_ibft_tpu.verify import HostBatchVerifier
+
+        host = HostBatchVerifier(src)
+        t0 = time.perf_counter()
+        hm1 = host.verify_senders(prepares)
+        hm2 = host.verify_committed_seals(phash, seals, height=1)
+        host_ms = (time.perf_counter() - t0) * 1e3
+        baseline_name = "pure-Python sequential per-message verify"
+        assert hm1.all() and hm2.all()
+
+    print(
+        json.dumps(
+            {
+                "metric": "prepare_commit_quorum_verify_p50_100v",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(host_ms / p50, 2),
+                "baseline": baseline_name,
+                "baseline_ms": round(host_ms, 1),
+                "device": jax.devices()[0].platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

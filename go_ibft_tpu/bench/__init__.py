@@ -1,0 +1,5 @@
+"""Benchmark harness: workload builders + timing for BASELINE.md configs."""
+
+from .workload import RoundWorkload, build_round_workload
+
+__all__ = ["RoundWorkload", "build_round_workload"]

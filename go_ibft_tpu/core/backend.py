@@ -101,8 +101,12 @@ class BatchVerifier(Protocol):
         self,
         proposal_hash: bytes,
         seals: Sequence[CommittedSeal],
+        height: int,
     ) -> np.ndarray:
-        """Mask of IsValidCommittedSeal over a seal batch for one hash."""
+        """Mask of IsValidCommittedSeal over a seal batch for one hash.
+
+        ``height`` selects the validator set the signers must belong to.
+        """
         ...
 
 

@@ -231,7 +231,20 @@ class IBFT:
                     self.log.debug("sequence cancelled")
                     raise
 
-                if signals.new_proposal.done():
+                # Arbitration order: the reference's Go select picks randomly
+                # among ready channels (core/ibft.go:354-393), so no signal is
+                # ever systematically starved.  Deterministic asyncio must pick
+                # an order; round_done goes FIRST: if consensus finished while
+                # the loop was busy (e.g. a verifier compile stalled it past the
+                # round timer), finishing beats a moot round change — the
+                # liveness-safe resolution of the tie the reference leaves to
+                # chance.
+                if signals.round_done.done():
+                    # Consensus for this height is finished (ibft.go:376-382).
+                    await teardown()
+                    self._insert_block()
+                    return
+                elif signals.new_proposal.done():
                     ev: _NewProposalEvent = signals.new_proposal.result()
                     await teardown()
                     self.log.info("received future proposal", ev.round)
@@ -253,11 +266,6 @@ class IBFT:
                     new_round = current_round + 1
                     self._move_to_new_round(new_round)
                     self._send_round_change_message(height, new_round)
-                elif signals.round_done.done():
-                    # Consensus for this height is finished (ibft.go:376-382).
-                    await teardown()
-                    self._insert_block()
-                    return
         finally:
             self._signals = None
             set_gauge(("go-ibft", "sequence", "duration"), time.monotonic() - start_time)
@@ -645,7 +653,9 @@ class IBFT:
             # All candidates share the proposal hash (hash check passed), so
             # one batch per view suffices.
             mask = self.batch_verifier.verify_committed_seals(
-                candidates[0][1], [seal for _, _, seal in candidates]
+                candidates[0][1],
+                [seal for _, _, seal in candidates],
+                view.height,
             )
             for (message, _, _), ok in zip(candidates, mask):
                 if bool(ok):

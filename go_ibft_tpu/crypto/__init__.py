@@ -1,0 +1,32 @@
+"""Host-side cryptography for go_ibft_tpu.
+
+The reference deliberately contains no cryptography — hashing, signing and
+verification are injected by the embedder (go-ibft core/backend.go:37-56,
+README.md:6-13).  This package provides a complete embedder-side crypto
+stack so the framework is usable standalone:
+
+* :mod:`.keccak` — Keccak-256 (Ethereum flavor), pure Python with an
+  optional native C++ fast path (:mod:`go_ibft_tpu.native`).
+* :mod:`.ecdsa` — secp256k1 key generation, deterministic signing,
+  verification and public-key recovery over Python ints; the host
+  reference against which the TPU kernels (:mod:`go_ibft_tpu.ops`) are
+  tested bit-for-bit.
+"""
+
+from .keccak import keccak256
+from .ecdsa import (
+    PrivateKey,
+    pubkey_to_address,
+    sign,
+    verify,
+    recover,
+)
+
+__all__ = [
+    "keccak256",
+    "PrivateKey",
+    "pubkey_to_address",
+    "sign",
+    "verify",
+    "recover",
+]

@@ -1,0 +1,146 @@
+"""ctypes loader for the native C++ runtime components.
+
+Builds ``libibft_native.so`` from source on first use (g++ is part of the
+toolchain; there is no pip dependency), then exposes:
+
+* :func:`keccak256` — fast host hashing (also auto-registered as the
+  :mod:`go_ibft_tpu.crypto.keccak` fast path via :func:`install`);
+* :func:`ecdsa_verify` / :func:`ecdsa_recover` — per-message host crypto;
+* :func:`verify_batch_sequential` — the sequential per-message loop used
+  as the benchmark baseline denominator (the reference embedder's Go
+  crypto/ecdsa shape, go-ibft messages/messages.go:183-198).
+
+Everything degrades gracefully: if no compiler is available the pure
+Python paths keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "ibft_native.cc")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_LIB_DIR, "libibft_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=300
+        )
+    except (OSError, subprocess.TimeoutExpired) as err:
+        return f"{type(err).__name__}: {err}"
+    if proc.returncode != 0:
+        return proc.stderr[-2000:]
+    return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            err = _build()
+            if err is not None:
+                _build_error = err
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.ibft_keccak256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ibft_ecdsa_verify.argtypes = [ctypes.c_char_p] * 3
+        lib.ibft_ecdsa_verify.restype = ctypes.c_int
+        lib.ibft_ecdsa_recover.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.ibft_ecdsa_recover.restype = ctypes.c_int
+        lib.ibft_verify_batch_sequential.argtypes = [
+            ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_char_p, ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+def keccak256(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    out = ctypes.create_string_buffer(32)
+    lib.ibft_keccak256(data, len(data), out)
+    return out.raw
+
+
+def ecdsa_verify(pub_xy: bytes, digest: bytes, rs: bytes) -> bool:
+    """pub_xy = X||Y (64B, big-endian), rs = r||s (64B, big-endian)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    return bool(lib.ibft_ecdsa_verify(pub_xy, digest, rs))
+
+
+def ecdsa_recover(digest: bytes, rs: bytes, v: int) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    out = ctypes.create_string_buffer(64)
+    if not lib.ibft_ecdsa_recover(digest, rs, v, out):
+        return None
+    return out.raw
+
+
+def verify_batch_sequential(
+    digests: Sequence[bytes],
+    sigs: Sequence[bytes],
+    claimed: Sequence[bytes],
+    table: Sequence[bytes],
+) -> np.ndarray:
+    """The baseline loop: one recover+address+membership per message."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    n = len(digests)
+    out = np.zeros(n, dtype=np.uint8)
+    lib.ibft_verify_batch_sequential(
+        n,
+        b"".join(digests),
+        b"".join(sigs),
+        b"".join(claimed),
+        len(table),
+        b"".join(table),
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out.astype(bool)
+
+
+def install() -> bool:
+    """Register the native keccak as the crypto-layer fast path.
+
+    Returns True when the native library is active."""
+    lib = load()
+    if lib is None:
+        return False
+    from ..crypto import keccak as keccak_mod
+
+    keccak_mod.set_native_impl(keccak256)
+    return True

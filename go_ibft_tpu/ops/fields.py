@@ -59,6 +59,8 @@ __all__ = [
     "is_zero",
     "eq_mod",
     "select",
+    "exact_carry",
+    "ge_const",
 ]
 
 
@@ -332,6 +334,57 @@ def _sub_exact(a: jnp.ndarray, ref: np.ndarray) -> jnp.ndarray:
     xs = jnp.moveaxis(a - jnp.asarray(ref, dtype=jnp.int32), -1, 0)
     _, ys = jax.lax.scan(step, jnp.zeros(a.shape[:-1], dtype=a.dtype), xs)
     return jnp.moveaxis(ys, 0, -1)
+
+
+# Public aliases: raw (non-modular) exact carry + lexicographic compare, used
+# by curve code for range checks like ``r < n`` on unreduced 256-bit inputs.
+exact_carry = _exact_carry
+ge_const = _ge_const
+
+
+def _ks_carry(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact carry for limbs in ``[0, 2**13]`` via Kogge-Stone prefix OR.
+
+    The lazy-carry passes leave limbs with at most a single overflow bit, so
+    carry propagation is binary and resolves in log2(nlimbs) *vector* steps —
+    unlike :func:`_exact_carry`'s sequential ``lax.scan``, this keeps the
+    256-step EC ladder free of inner serial chains (the single biggest
+    runtime cost of the complete-addition exception tests).
+    """
+    g0 = a >> LIMB_BITS  # generate in {0, 1}
+    base = a & LIMB_MASK
+    zero = jnp.zeros(a.shape[:-1] + (1,), dtype=a.dtype)
+    s = base + jnp.concatenate([zero, g0[..., :-1]], axis=-1)  # in [0, 2**13]
+    gen = s >> LIMB_BITS
+    prop = (s == LIMB_MASK).astype(a.dtype)
+    # inclusive prefix: carry_out[i] = gen[i] | (prop[i] & carry_out[i-1])
+    nl = a.shape[-1]
+    pad_axes = [(0, 0)] * (a.ndim - 1)
+    d = 1
+    while d < nl:
+        gen = gen | (prop & jnp.pad(gen[..., :-d], pad_axes + [(d, 0)]))
+        prop = prop & jnp.pad(prop[..., :-d], pad_axes + [(d, 0)])
+        d *= 2
+    carry_in = jnp.concatenate([zero, gen[..., :-1]], axis=-1)
+    return (s + carry_in) & LIMB_MASK
+
+
+def canon_value(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """Unique canonical limbs of the *value* of a semi-reduced input.
+
+    Input limbs must lie in ``[0, 2**13]`` (true for every op output here);
+    the value stays in ``[0, 2p)`` — NOT reduced mod p.  Branch-free,
+    scan-free (see :func:`_ks_carry`)."""
+    return _ks_carry(a)
+
+
+def is_zero_fast(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """``a === 0 (mod p)`` for semi-reduced ``a`` (< 2p): value 0 or p.
+
+    Scan-free: canonical limbs are unique, so two vector compares decide."""
+    c = _ks_carry(a)
+    p_limbs = jnp.asarray(m.limbs)
+    return jnp.all(c == 0, axis=-1) | jnp.all(c == p_limbs, axis=-1)
 
 
 def is_zero(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:

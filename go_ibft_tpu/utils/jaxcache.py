@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache management.
+
+The verification kernels are 256-step EC ladders — minutes to compile cold,
+milliseconds to load from the persistent cache.  A consensus engine cannot
+stall mid-round for a compile (the round timer would expire, SURVEY.md §7
+(d)), so anything constructing device verifiers should enable the cache and
+pre-warm the hot shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_DEFAULT_DIR = os.path.expanduser("~/.cache/go_ibft_tpu/xla")
+
+_enabled = False
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> None:
+    """Idempotently enable the JAX persistent compilation cache.
+
+    Respects an existing user-configured cache dir; otherwise uses
+    ``~/.cache/go_ibft_tpu/xla`` (override with ``path`` or the
+    ``JAX_COMPILATION_CACHE_DIR`` env var, which JAX reads natively).
+    """
+    global _enabled
+    if _enabled:
+        return
+    current = jax.config.jax_compilation_cache_dir
+    if current is None:
+        target = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
+        os.makedirs(target, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", target)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    _enabled = True

@@ -1,0 +1,19 @@
+"""Batched signature verification backends.
+
+Three interchangeable implementations of the
+:class:`go_ibft_tpu.core.backend.BatchVerifier` protocol (SURVEY.md §7
+stage 4):
+
+* :class:`HostBatchVerifier` — sequential Python ints; the reference
+  semantics oracle and the CI stand-in when no accelerator exists.
+* :class:`DeviceBatchVerifier` — one ``jit`` batch per phase on whatever
+  JAX backend is active (TPU in production, CPU in tests); the framework's
+  headline capability.
+
+Both return identical boolean masks for identical inputs — determinism
+across backends is part of the conformance suite.
+"""
+
+from .batch import DeviceBatchVerifier, HostBatchVerifier, SIG_BYTES
+
+__all__ = ["DeviceBatchVerifier", "HostBatchVerifier", "SIG_BYTES"]

@@ -1,0 +1,350 @@
+"""BatchVerifier implementations: host oracle + device batch kernels.
+
+The reference verifies one message at a time through embedder predicates
+under the store lock (go-ibft messages/messages.go:183-198 calling
+core/backend.go:37-56).  Here the same observable semantics — a validity
+mask over a message set — are produced by one fixed-shape device batch:
+
+    payload bytes --pack--> keccak blocks --digest--> ecrecover ladder
+                 --> pubkey --keccak--> address --compare--> mask
+
+Shapes are static per (batch-bucket, block-bucket, validator-bucket)
+triple; each distinct triple compiles once and is cached.  Lanes added by
+padding are masked out, so callers see exact-length numpy boolean masks.
+
+Signature format (shared with :mod:`go_ibft_tpu.crypto.backend`):
+65 bytes ``r(32, big-endian) || s(32, big-endian) || v(1)``, signing
+``keccak256(payload_no_sig)`` for envelopes and the proposal hash directly
+for committed seals.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Mapping, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import ecdsa as host_ecdsa
+from ..crypto.keccak import keccak256
+from ..messages.helpers import CommittedSeal
+from ..messages.wire import IbftMessage
+from ..ops import fields
+from ..ops import keccak as dk
+from ..ops import quorum
+from ..ops import secp256k1 as sec
+
+SIG_BYTES = 65  # r(32) || s(32) || v(1)
+
+ADDRESS_BYTES = 20
+
+# Pad-to buckets: batch lanes, keccak blocks per message, validator-set size.
+_BATCH_BUCKETS = (8, 32, 128, 512, 2048)
+_BLOCK_BUCKETS = (1, 2, 4, 8, 16, 32)
+_TABLE_BUCKETS = (8, 32, 128, 512, 2048)
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
+
+
+def split_signature(sig: bytes) -> Tuple[int, int, int]:
+    """65-byte ``r || s || v`` -> ints; raises on wrong length."""
+    if len(sig) != SIG_BYTES:
+        raise ValueError(f"signature must be {SIG_BYTES} bytes, got {len(sig)}")
+    return (
+        int.from_bytes(sig[:32], "big"),
+        int.from_bytes(sig[32:64], "big"),
+        sig[64],
+    )
+
+
+ValidatorSource = Callable[[int], Mapping[bytes, int]]
+
+
+class HostBatchVerifier:
+    """Sequential per-item verification over Python ints.
+
+    Mirrors exactly what the reference does per message — the semantics
+    oracle the device path must match, and the honest baseline denominator
+    for BASELINE.md's >=30x target.
+    """
+
+    def __init__(self, validators_for_height: ValidatorSource):
+        self._validators = validators_for_height
+
+    def _is_member(self, height: int, address: bytes) -> bool:
+        return address in self._validators(height)
+
+    def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
+        out = np.zeros(len(msgs), dtype=bool)
+        for i, msg in enumerate(msgs):
+            if msg.view is None or len(msg.sender) != ADDRESS_BYTES:
+                continue
+            if len(msg.signature) != SIG_BYTES:
+                continue
+            r, s, v = split_signature(msg.signature)
+            digest = keccak256(msg.encode(include_signature=False))
+            pub = host_ecdsa.recover(digest, r, s, v)
+            if pub is None:
+                continue
+            out[i] = (
+                host_ecdsa.pubkey_to_address(*pub) == msg.sender
+                and self._is_member(msg.view.height, msg.sender)
+            )
+        return out
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        out = np.zeros(len(seals), dtype=bool)
+        for i, seal in enumerate(seals):
+            if len(seal.signer) != ADDRESS_BYTES or len(seal.signature) != SIG_BYTES:
+                continue
+            r, s, v = split_signature(seal.signature)
+            pub = host_ecdsa.recover(proposal_hash, r, s, v)
+            if pub is None:
+                continue
+            out[i] = (
+                host_ecdsa.pubkey_to_address(*pub) == seal.signer
+                and self._is_member(height, seal.signer)
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (shape-polymorphic via jit retrace per bucket triple)
+# ---------------------------------------------------------------------------
+
+
+# Two-dispatch pipeline: the digest program recompiles per payload-size
+# bucket (cheap keccak scan); the recovery program — the expensive 256-step
+# EC ladder — compiles once per lane bucket and serves BOTH envelope senders
+# and committed seals.
+_digest_kernel = jax.jit(quorum.digest_words)
+
+
+@jax.jit
+def _recover_kernel(zw, r, s, v, claimed_w, table_w, live):
+    ok = quorum.sig_checks_zw(zw, r, s, v, claimed_w, live)
+    member = jnp.any(quorum.membership_eq(claimed_w, table_w), axis=-1)
+    return ok & member
+
+
+def _pack_scalars(values: List[int], pad_to: int) -> jnp.ndarray:
+    values = values + [0] * (pad_to - len(values))
+    return jnp.asarray(fields.to_limbs(values, sec.FIELD.nlimbs))
+
+
+def pack_validator_table(addresses: Sequence[bytes], bucket: bool = True) -> np.ndarray:
+    """Addresses -> ``(V, 5)`` uint32 words, padded by repeating row 0."""
+    addresses = [a for a in addresses if len(a) == ADDRESS_BYTES]
+    if not addresses:
+        raise ValueError("empty validator set")
+    v = _bucket(len(addresses), _TABLE_BUCKETS) if bucket else len(addresses)
+    table = np.zeros((v, 5), dtype=np.uint32)
+    for i, a in enumerate(addresses):
+        table[i] = dk.address_to_words(a)
+    for i in range(len(addresses), v):
+        table[i] = table[0]  # padding adds no new member
+    return table
+
+
+def pack_sender_batch(msgs: Sequence[IbftMessage], pad_lanes: int = 0):
+    """Messages -> device-ready arrays for the sender-validity kernel.
+
+    Returns ``(blocks, counts, r, s, v, senders, live)`` as numpy/jax
+    arrays padded to bucketed static shapes.  Callers must pre-filter
+    malformed messages (wrong sender/signature length).
+    """
+    n = len(msgs)
+    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    payloads = [m.encode(include_signature=False) for m in msgs]
+    max_len = max(len(p) for p in payloads)
+    nb = _bucket((max_len + 1 + dk.RATE_BYTES - 1) // dk.RATE_BYTES, _BLOCK_BUCKETS)
+    blocks = np.zeros((bb, nb, 17, 2), dtype=np.uint32)
+    counts = np.ones((bb,), dtype=np.int32)
+    pb, pc = dk.pack_messages(payloads, nb)
+    blocks[:n] = pb
+    counts[:n] = pc
+    rs, ss, vs = [], [], []
+    senders = np.zeros((bb, 5), dtype=np.uint32)
+    for i, m in enumerate(msgs):
+        r, s, v = split_signature(m.signature)
+        rs.append(r)
+        ss.append(s)
+        vs.append(v)
+        senders[i] = dk.address_to_words(m.sender)
+    live = np.zeros((bb,), dtype=bool)
+    live[:n] = True
+    return (
+        blocks,
+        counts,
+        np.asarray(_pack_scalars(rs, bb)),
+        np.asarray(_pack_scalars(ss, bb)),
+        np.pad(np.asarray(vs, np.int32), (0, bb - n)),
+        senders,
+        live,
+    )
+
+
+def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_lanes: int = 0):
+    """Seals -> device-ready arrays for the seal-validity kernel.
+
+    Returns ``(hash_words, r, s, v, signers, live)``; the proposal hash is
+    broadcast to every lane as little-endian value words.
+    """
+    n = len(seals)
+    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
+    hash_zw = np.broadcast_to(hw, (bb, 8)).copy()
+    rs, ss, vs = [], [], []
+    signers = np.zeros((bb, 5), dtype=np.uint32)
+    for i, seal in enumerate(seals):
+        r, s, v = split_signature(seal.signature)
+        rs.append(r)
+        ss.append(s)
+        vs.append(v)
+        signers[i] = dk.address_to_words(seal.signer)
+    live = np.zeros((bb,), dtype=bool)
+    live[:n] = True
+    return (
+        hash_zw,
+        np.asarray(_pack_scalars(rs, bb)),
+        np.asarray(_pack_scalars(ss, bb)),
+        np.pad(np.asarray(vs, np.int32), (0, bb - n)),
+        signers,
+        live,
+    )
+
+
+class DeviceBatchVerifier:
+    """One ``jit`` batch per phase on the active JAX backend.
+
+    ``validators_for_height`` supplies the voting-power map (the engine's
+    ``ValidatorBackend.get_voting_powers`` works directly); validator
+    address tables are packed to device arrays once per height and cached.
+    """
+
+    def __init__(self, validators_for_height: ValidatorSource, cache_heights: int = 4):
+        from ..utils.jaxcache import enable_persistent_cache
+
+        enable_persistent_cache()
+        self._validators = validators_for_height
+        self._tables: Dict[int, Tuple[np.ndarray, int]] = {}
+        self._cache_heights = cache_heights
+
+    def warmup(
+        self,
+        lanes: Sequence[int] = (8,),
+        blocks: Sequence[int] = (1, 2, 4),
+        table_rows: int = 8,
+    ) -> None:
+        """Pre-compile the kernels for the given shape buckets.
+
+        A consensus engine must never stall mid-round on an XLA compile
+        (the round timer would expire and tear the round down); call this
+        once at node startup.  With the persistent cache, repeat processes
+        pay only a cache load.
+        """
+        for bb in lanes:
+            zw = jnp.zeros((bb, 8), dtype=jnp.uint32)
+            _recover_kernel(
+                zw,
+                jnp.zeros((bb, 20), jnp.int32),
+                jnp.zeros((bb, 20), jnp.int32),
+                jnp.zeros((bb,), jnp.int32),
+                jnp.zeros((bb, 5), jnp.uint32),
+                jnp.zeros((table_rows, 5), jnp.uint32),
+                jnp.zeros((bb,), bool),
+            ).block_until_ready()
+            for nb in blocks:
+                _digest_kernel(
+                    jnp.zeros((bb, nb, 17, 2), jnp.uint32),
+                    jnp.ones((bb,), jnp.int32),
+                ).block_until_ready()
+
+    # -- validator table management ------------------------------------
+
+    def _table(self, height: int) -> np.ndarray:
+        hit = self._tables.get(height)
+        if hit is not None:
+            return hit[0]
+        table = pack_validator_table(list(self._validators(height)))
+        self._tables[height] = (table, table.shape[0])
+        if len(self._tables) > self._cache_heights:
+            self._tables.pop(min(self._tables))
+        return table
+
+    # -- BatchVerifier protocol ----------------------------------------
+
+    def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
+        if not msgs:
+            return np.zeros(0, dtype=bool)
+        out = np.zeros(len(msgs), dtype=bool)
+        by_height: Dict[int, List[int]] = {}
+        for i, m in enumerate(msgs):
+            if (
+                m.view is not None
+                and len(m.sender) == ADDRESS_BYTES
+                and len(m.signature) == SIG_BYTES
+            ):
+                by_height.setdefault(m.view.height, []).append(i)
+        for height, idxs in by_height.items():
+            mask = self._verify_senders_same_height(
+                [msgs[i] for i in idxs], height
+            )
+            out[np.asarray(idxs)] = mask
+        return out
+
+    def _verify_senders_same_height(
+        self, msgs: List[IbftMessage], height: int
+    ) -> np.ndarray:
+        n = len(msgs)
+        blocks, counts, r, s, v, senders, live = pack_sender_batch(msgs)
+        table = self._table(height)
+        zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
+        mask = _recover_kernel(
+            zw,
+            jnp.asarray(r),
+            jnp.asarray(s),
+            jnp.asarray(v),
+            jnp.asarray(senders),
+            jnp.asarray(table),
+            jnp.asarray(live),
+        )
+        return np.asarray(mask)[:n]
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        if not seals:
+            return np.zeros(0, dtype=bool)
+        n = len(seals)
+        out = np.zeros(n, dtype=bool)
+        idxs = [
+            i
+            for i, seal in enumerate(seals)
+            if len(seal.signer) == ADDRESS_BYTES and len(seal.signature) == SIG_BYTES
+        ]
+        if not idxs or len(proposal_hash) != 32:
+            return out
+        hash_zw, r, s, v, signers, live = pack_seal_batch(
+            proposal_hash, [seals[i] for i in idxs]
+        )
+        table = self._table(height)
+        mask = _recover_kernel(
+            jnp.asarray(hash_zw),
+            jnp.asarray(r),
+            jnp.asarray(s),
+            jnp.asarray(v),
+            jnp.asarray(signers),
+            jnp.asarray(table),
+            jnp.asarray(live),
+        )
+        out[np.asarray(idxs)] = np.asarray(mask)[: len(idxs)]
+        return out

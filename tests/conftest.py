@@ -21,6 +21,13 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
+# Persistent XLA compilation cache: the crypto kernels (256-step EC ladders)
+# take minutes to compile on CPU the first time; cache makes reruns cheap.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_go_ibft_tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
 
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):

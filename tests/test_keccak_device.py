@@ -1,0 +1,50 @@
+"""Device-batched Keccak-256 vs the host implementation.
+
+Byte-for-byte agreement across message lengths (empty, sub-block, exact
+rate, multi-block), plus the on-device pubkey -> address pipeline used by
+the sender-identity hot path.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from go_ibft_tpu.crypto import ecdsa as host
+from go_ibft_tpu.crypto import keccak256
+from go_ibft_tpu.ops import fields
+from go_ibft_tpu.ops import keccak as dk
+
+
+def test_keccak_blocks_matches_host():
+    msgs = [b"", b"abc", b"q" * 135, b"r" * 136, b"s" * 137, b"t" * 300]
+    blocks, nb = dk.pack_messages(msgs, max_blocks=4)
+    dig = dk.keccak256_blocks(jnp.asarray(blocks), jnp.asarray(nb))
+    for i, m in enumerate(msgs):
+        assert dk.digest_words_to_bytes(np.asarray(dig[i])) == keccak256(m)
+
+
+def test_pack_messages_bucket_overflow():
+    import pytest
+
+    with pytest.raises(ValueError):
+        dk.pack_messages([b"x" * 500], max_blocks=2)
+
+
+def test_pubkey_to_address_on_device():
+    keys = [host.PrivateKey.from_seed(f"addr-{i}".encode()) for i in range(4)]
+    qx = jnp.asarray(fields.to_limbs([k.pubkey[0] for k in keys], 20))
+    qy = jnp.asarray(fields.to_limbs([k.pubkey[1] for k in keys], 20))
+    words = dk.pubkey_to_address_words(qx, qy)
+    for i, k in enumerate(keys):
+        assert np.array_equal(np.asarray(words[i]), dk.address_to_words(k.address))
+
+
+def test_limbs_words_roundtrip():
+    rng = np.random.default_rng(3)
+    vals = [int.from_bytes(rng.bytes(32), "big") for _ in range(8)]
+    limbs = jnp.asarray(fields.to_limbs(vals, 20))
+    words = dk.limbs_to_words_le(limbs)
+    assert fields.from_limbs(dk.words_le_to_limbs(words, 20)) == vals
+    # words match the little-endian uint32 decomposition
+    for i, v in enumerate(vals):
+        expect = [(v >> (32 * j)) & 0xFFFFFFFF for j in range(8)]
+        assert list(np.asarray(words[i])) == expect

@@ -1,0 +1,64 @@
+"""Sharded quorum verification over a multi-device mesh.
+
+Runs on the 8 virtual CPU devices (conftest forces
+``--xla_force_host_platform_device_count=8``); asserts the sharded result
+equals the single-device result exactly — the determinism contract across
+partitionings.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_ibft_tpu.bench import build_round_workload
+from go_ibft_tpu.ops.quorum import quorum_certify
+from go_ibft_tpu.parallel import make_mesh, mesh_quorum_certify
+
+
+def _args(w):
+    blocks, counts, r, s, v, senders, live = w.prepare
+    return (
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+
+
+@pytest.fixture(scope="module")
+def cpu8():
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    return devices[:8]
+
+
+@pytest.mark.parametrize("vp", [1, 2])
+def test_mesh_matches_single_device(cpu8, vp):
+    w = build_round_workload(8, corrupt_frac=0.25, seed=5, pad_lanes=8)
+    args = _args(w)
+    mesh = make_mesh(8, vp=vp, devices=cpu8)
+    sharded = mesh_quorum_certify(mesh)
+    # single-CPU-device reference (same platform as the sharded run)
+    ref_mesh = make_mesh(1, devices=cpu8[:1])
+    ref = mesh_quorum_certify(ref_mesh)
+    got = [np.asarray(x) for x in sharded(*args)]
+    want = [np.asarray(x) for x in ref(*args)]
+    for g, x in zip(got, want):
+        assert np.array_equal(g, x)
+    n = w.n_validators
+    assert np.array_equal(got[0][:n], w.expected_prepare_mask)
+
+
+def test_mesh_device_count_validation(cpu8):
+    with pytest.raises(ValueError):
+        make_mesh(8, vp=3, devices=cpu8)

@@ -1,0 +1,83 @@
+"""Native C++ components vs the pure-Python reference implementations."""
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu import native
+from go_ibft_tpu.crypto import PrivateKey, keccak256, sign
+from go_ibft_tpu.crypto import ecdsa as host
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason=f"native build unavailable: {native.build_error()}"
+)
+
+
+def test_native_keccak_matches_python():
+    from go_ibft_tpu.crypto.keccak import _keccak256_py
+
+    for msg in [b"", b"abc", b"q" * 135, b"r" * 136, b"s" * 137, b"t" * 5000]:
+        assert native.keccak256(msg) == _keccak256_py(msg)
+
+
+def test_native_ecdsa_verify_and_recover():
+    k = PrivateKey.from_seed(b"native-parity")
+    x, y = k.pubkey
+    digest = keccak256(b"payload")
+    r, s, v = sign(k, digest)
+    pub = x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    rs = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    assert native.ecdsa_verify(pub, digest, rs)
+    assert not native.ecdsa_verify(pub, keccak256(b"other"), rs)
+    assert native.ecdsa_recover(digest, rs, v) == pub
+    assert native.ecdsa_recover(digest, rs, v ^ 1) != pub
+    # out-of-range signature components
+    bad = (host.N).to_bytes(32, "big") + s.to_bytes(32, "big")
+    assert not native.ecdsa_verify(pub, digest, bad)
+    assert native.ecdsa_recover(digest, bad, v) is None
+
+
+def test_native_random_roundtrip_against_python():
+    rng = np.random.default_rng(11)
+    for i in range(6):
+        k = PrivateKey.from_seed(bytes(rng.bytes(16)))
+        digest = keccak256(rng.bytes(50))
+        r, s, v = sign(k, digest)
+        rs = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        # python oracle agrees with native on verify and recover
+        assert host.verify(*k.pubkey, digest, r, s)
+        pub = native.ecdsa_recover(digest, rs, v)
+        assert pub is not None
+        assert (
+            int.from_bytes(pub[:32], "big"),
+            int.from_bytes(pub[32:], "big"),
+        ) == k.pubkey
+
+
+def test_native_sequential_batch_masks():
+    n = 8
+    keys = [PrivateKey.from_seed(f"sb-{i}".encode()) for i in range(n)]
+    digests = [keccak256(f"m{i}".encode()) for i in range(n)]
+    sigs = []
+    for k, d in zip(keys, digests):
+        r, s, v = sign(k, d)
+        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v]))
+    claimed = [k.address for k in keys]
+    table = list(claimed)
+    mask = native.verify_batch_sequential(digests, sigs, claimed, table)
+    assert mask.all()
+    # corrupt one signature; claim someone else's address; drop one from table
+    sigs[2] = sigs[2][:8] + bytes([sigs[2][8] ^ 1]) + sigs[2][9:]
+    claimed[4] = keys[5].address
+    table_small = table[:7]  # validator 7 no longer a member
+    mask = native.verify_batch_sequential(digests, sigs, claimed, table_small)
+    assert list(mask) == [True, True, False, True, False, True, True, False]
+
+
+def test_native_install_fast_path():
+    from go_ibft_tpu.crypto import keccak as keccak_mod
+
+    assert native.install()
+    try:
+        assert keccak_mod.keccak256(b"installed") == native.keccak256(b"installed")
+    finally:
+        keccak_mod.set_native_impl(None)

@@ -1,0 +1,147 @@
+"""Fused quorum certification: correctness of masks + exact power math.
+
+Covers SURVEY.md §2 #3's device mapping: masked voting-power reduction
+fused after batch verification, duplicate-sender spam resistance, and the
+Byzantine-mix masking of BASELINE.md config #5 (scaled down for CI).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from go_ibft_tpu.bench import build_round_workload
+from go_ibft_tpu.ops.quorum import (
+    quorum_certify,
+    seal_quorum_certify,
+    split_power,
+)
+
+
+def _prep_args(w):
+    blocks, counts, r, s, v, senders, live = w.prepare
+    return (
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+
+
+def _seal_args(w):
+    hz, r, s, v, signers, live = w.seals
+    return (
+        jnp.asarray(hz),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(signers),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+
+
+@pytest.fixture(scope="module")
+def clean_round():
+    return build_round_workload(8)
+
+
+def test_all_valid_reaches_quorum(clean_round):
+    w = clean_round
+    mask, reached, lo, hi = quorum_certify(*_prep_args(w))
+    n = w.n_validators
+    assert np.asarray(mask)[:n].all()
+    assert not np.asarray(mask)[n:].any()  # padding lanes dead
+    assert bool(np.asarray(reached))
+    assert int(np.asarray(hi)) * 65536 + int(np.asarray(lo)) == n
+
+
+def test_seal_phase_all_valid(clean_round):
+    w = clean_round
+    mask, reached, lo, hi = seal_quorum_certify(*_seal_args(w))
+    n = w.n_validators
+    assert np.asarray(mask)[:n].all() and bool(np.asarray(reached))
+    assert int(np.asarray(hi)) * 65536 + int(np.asarray(lo)) == n
+
+
+def test_byzantine_mix_masks_bad_sigs():
+    """Scaled BASELINE config #5: 30% corrupted signatures are masked and
+    quorum fails exactly when valid power < floor(2T/3)+1."""
+    w = build_round_workload(9, corrupt_frac=0.34, seed=3)
+    mask, reached, lo, hi = quorum_certify(*_prep_args(w))
+    n = w.n_validators
+    assert np.array_equal(np.asarray(mask)[:n], w.expected_prepare_mask)
+    valid_power = int(w.expected_prepare_mask.sum())
+    threshold = (2 * n) // 3 + 1
+    assert bool(np.asarray(reached)) == (valid_power >= threshold)
+    smask, sreached, _, _ = seal_quorum_certify(*_seal_args(w))
+    assert np.array_equal(np.asarray(smask)[:n], w.expected_seal_mask)
+
+
+def test_duplicate_sender_spam_counts_once():
+    """A validator repeating its (valid) message must not inflate power."""
+    w = build_round_workload(4)
+    blocks, counts, r, s, v, senders, live = [
+        np.asarray(x).copy() for x in w.prepare
+    ]
+    # duplicate validator 0's lane into the padding lanes and mark them live
+    for lane in range(4, 8):
+        blocks[lane] = blocks[0]
+        counts[lane] = counts[0]
+        r[lane] = r[0]
+        s[lane] = s[0]
+        v[lane] = v[0]
+        senders[lane] = senders[0]
+        live[lane] = True
+    mask, reached, lo, hi = quorum_certify(
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+    assert np.asarray(mask).all()  # every copy is individually valid...
+    total = int(np.asarray(hi)) * 65536 + int(np.asarray(lo))
+    assert total == 4  # ...but power counts each validator once
+
+
+def test_split_power_bounds():
+    assert split_power(0) == (0, 0)
+    assert split_power((1 << 31) - 1) == (0xFFFF, 0x7FFF)
+    with pytest.raises(ValueError):
+        split_power(1 << 31)
+
+
+def test_quorum_threshold_edge():
+    """Exactly-at-threshold power reaches quorum; one unit below fails."""
+    w = build_round_workload(4)
+    n = 4
+    threshold = (2 * n) // 3 + 1  # = 3
+    # corrupt exactly n - threshold + 1 = 2 lanes -> power 2 < 3
+    w_bad = build_round_workload(4, corrupt_frac=0.5, seed=1)
+    assert int(w_bad.expected_prepare_mask.sum()) == 2
+    _, reached_bad, _, _ = quorum_certify(*_prep_args(w_bad))
+    assert not bool(np.asarray(reached_bad))
+    # corrupt 1 lane -> power 3 == threshold -> reached
+    w_edge = build_round_workload(4, corrupt_frac=0.25, seed=2)
+    assert int(w_edge.expected_prepare_mask.sum()) == 3
+    _, reached_edge, _, _ = quorum_certify(*_prep_args(w_edge))
+    assert bool(np.asarray(reached_edge))

@@ -1,0 +1,183 @@
+"""Device secp256k1 kernels vs the host int oracle.
+
+Every lane of every batched op must match :mod:`go_ibft_tpu.crypto.ecdsa`
+bit-for-bit — this is the determinism requirement of SURVEY.md §7 (e):
+verification results must agree across CPU/TPU backends.
+
+Kernels compile once per process; tests share fixtures to amortize.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from go_ibft_tpu.crypto import ecdsa as host
+from go_ibft_tpu.crypto import keccak256
+from go_ibft_tpu.ops import fields
+from go_ibft_tpu.ops import secp256k1 as sec
+
+L = sec.FIELD.nlimbs
+
+
+def pack(vals):
+    return jnp.asarray(fields.to_limbs(list(vals), L))
+
+
+@pytest.fixture(scope="module")
+def points():
+    rng = np.random.default_rng(7)
+    ks = [int.from_bytes(rng.bytes(32), "big") % host.N for _ in range(4)]
+    pts = [host.scalar_mul(k, (host.GX, host.GY)) for k in ks]
+    X = pack(p[0] for p in pts)
+    Y = pack(p[1] for p in pts)
+    one = jnp.broadcast_to(jnp.asarray(sec.FIELD.const(1)), X.shape)
+    return pts, sec.JacobianPoint(X, Y, one)
+
+
+def unpack_affine(j):
+    x, y = sec.to_affine(j)
+    return list(zip(fields.from_limbs(x), fields.from_limbs(y)))
+
+
+def test_point_double(points):
+    pts, J = points
+    assert unpack_affine(sec.point_double(J)) == [host._add(p, p) for p in pts]
+
+
+def test_point_add_generic(points):
+    pts, J = points
+    J2 = sec.JacobianPoint(
+        jnp.roll(J.x, 1, axis=0), jnp.roll(J.y, 1, axis=0), J.z
+    )
+    expected = [host._add(pts[i], pts[(i - 1) % 4]) for i in range(4)]
+    assert unpack_affine(sec.point_add(J, J2)) == expected
+
+
+def test_point_add_exceptional_cases(points):
+    pts, J = points
+    # P + P must fall back to doubling
+    assert unpack_affine(sec.point_add(J, J)) == [host._add(p, p) for p in pts]
+    # P + (-P) = infinity
+    neg = sec.JacobianPoint(J.x, pack(host.P - p[1] for p in pts), J.z)
+    assert bool(sec.is_infinity(sec.point_add(J, neg)).all())
+    # P + infinity = P, both operand orders
+    inf = sec.point_infinity(J.x.shape[:-1])
+    assert unpack_affine(sec.point_add(J, inf)) == pts
+    assert unpack_affine(sec.point_add(inf, J)) == pts
+
+
+def test_on_curve(points):
+    pts, J = points
+    x = pack(p[0] for p in pts)
+    good = pack(p[1] for p in pts)
+    bad = pack((p[1] + 1) % host.P for p in pts)
+    assert bool(sec.on_curve(x, good).all())
+    assert not bool(sec.on_curve(x, bad).any())
+
+
+def test_ecmul2_base(points):
+    pts, J = points
+    rng = np.random.default_rng(8)
+    k1 = [int.from_bytes(rng.bytes(32), "big") % host.N for _ in range(4)]
+    k2 = [int.from_bytes(rng.bytes(32), "big") % host.N for _ in range(4)]
+    got = unpack_affine(sec.ecmul2_base(pack(k1), pack(k2), J.x, J.y))
+    expected = [
+        host._add(host.scalar_mul(a, (host.GX, host.GY)), host.scalar_mul(b, p))
+        for a, b, p in zip(k1, k2, pts)
+    ]
+    assert got == expected
+
+
+def test_ecmul2_zero_scalars(points):
+    pts, J = points
+    zeros = pack([0] * 4)
+    assert bool(sec.is_infinity(sec.ecmul2_base(zeros, zeros, J.x, J.y)).all())
+    # 0*G + 1*Q == Q
+    ones = pack([1] * 4)
+    assert unpack_affine(sec.ecmul2_base(zeros, ones, J.x, J.y)) == pts
+
+
+@pytest.fixture(scope="module")
+def signatures():
+    keys = [host.PrivateKey.from_seed(f"key-{i}".encode()) for i in range(6)]
+    digests = [keccak256(f"payload-{i}".encode()) for i in range(6)]
+    sigs = [host.sign(k, d) for k, d in zip(keys, digests)]
+    return keys, digests, sigs
+
+
+def test_ecdsa_verify_mask(signatures):
+    keys, digests, sigs = signatures
+    zs = [host.digest_to_scalar(d) for d in digests]
+    rs = [s[0] for s in sigs]
+    ss = [s[1] for s in sigs]
+    # corrupt: lane 3 wrong digest, lane 4 r=0, lane 5 s=N (out of range)
+    zs[3] = (zs[3] + 1) % host.N
+    rs[4] = 0
+    ss[5] = host.N
+    ok = sec.ecdsa_verify(
+        pack(k.pubkey[0] for k in keys),
+        pack(k.pubkey[1] for k in keys),
+        pack(zs),
+        pack(rs),
+        pack(ss),
+    )
+    assert list(np.asarray(ok)) == [True, True, True, False, False, False]
+
+
+def test_ecdsa_recover_roundtrip(signatures):
+    keys, digests, sigs = signatures
+    qx, qy, ok = sec.ecdsa_recover(
+        pack(host.digest_to_scalar(d) for d in digests),
+        pack(s[0] for s in sigs),
+        pack(s[1] for s in sigs),
+        jnp.asarray([s[2] for s in sigs]),
+    )
+    assert bool(np.asarray(ok).all())
+    got = list(zip(fields.from_limbs(qx), fields.from_limbs(qy)))
+    assert got == [k.pubkey for k in keys]
+    # device recovery agrees with the host recover oracle too
+    for d, (r, s, v), k in zip(digests, sigs, keys):
+        assert host.recover(d, r, s, v) == k.pubkey
+
+
+def test_ecdsa_recover_invalid_lanes(signatures):
+    keys, digests, sigs = signatures
+    rs = [s[0] for s in sigs]
+    ss = [s[1] for s in sigs]
+    vs = [s[2] for s in sigs]
+    rs[0] = 0  # out of range
+    ss[1] = host.N  # out of range
+    vs[2] = 5  # bad recovery id
+    _, _, ok = sec.ecdsa_recover(
+        pack(host.digest_to_scalar(d) for d in digests),
+        pack(rs),
+        pack(ss),
+        jnp.asarray(vs),
+    )
+    assert list(np.asarray(ok)) == [False, False, False, True, True, True]
+
+
+def test_keccak_vectors():
+    assert (
+        keccak256(b"").hex()
+        == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert (
+        keccak256(b"abc").hex()
+        == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block absorb (> 136-byte rate)
+    assert (
+        keccak256(b"a" * 200).hex()
+        == keccak256(b"a" * 100 + b"a" * 100).hex()
+    )
+
+
+def test_host_sign_verify_negative():
+    k = host.PrivateKey.from_seed(b"seed")
+    d = keccak256(b"msg")
+    r, s, _v = host.sign(k, d)
+    x, y = k.pubkey
+    assert host.verify(x, y, d, r, s)
+    assert not host.verify(x, y, keccak256(b"other"), r, s)
+    assert not host.verify(x, y, d, (r + 1) % host.N, s)

@@ -1,13 +1,23 @@
-"""Headline benchmark: 100-validator PREPARE+COMMIT quorum verification.
+"""BASELINE.md benchmark matrix.
 
-BASELINE.md config #2 — the north-star metric.  One IBFT round at 100
-validators produces 100 PREPARE envelopes and 100 COMMIT seals; the device
-must certify both phases (signature recovery, sender identity, validator
-membership, voting-power quorum) end-to-end.  Baseline denominator is the
-sequential per-message host verify loop — the shape of the reference's
-GetValidMessages/Verifier path (go-ibft messages/messages.go:183-198).
+Configs (BASELINE.json):
+  #1  4-validator happy-path RunSequence with real crypto (parity with the
+      reference's core/consensus_test.go flow)
+  #2  100-validator PREPARE+COMMIT fused quorum verification — THE
+      north-star metric (<2 ms p50, >=30x vs the sequential per-message
+      verify loop of go-ibft messages/messages.go:183-198)
+  #3  1000-validator batches, 10 height-batches pipelined — sustained
+      sig-verifies/sec/chip
+  #4  100-validator BLS12-381 aggregate COMMIT verification
+  #5  Byzantine mix: 300 validators, 30% corrupted signatures — mask
+      correctness + p50
 
-Prints ONE JSON line: {"metric", "value" (p50 ms), "unit", "vs_baseline"}.
+Prints one JSON line per config; the HEADLINE line (config #2, the
+``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST.
+
+A differential correctness smoke (device masks vs the host crypto oracle,
+including corrupted lanes) runs BEFORE any timing: a wrong kernel can
+never silently "benchmark".
 """
 
 import json
@@ -18,17 +28,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-N_VALIDATORS = 100
 REPS = 30
 
 
-def main() -> None:
-    from go_ibft_tpu.bench import build_round_workload
-    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+def _log(obj) -> None:
+    print(json.dumps(obj), flush=True)
 
-    w = build_round_workload(N_VALIDATORS)
+
+def _prep_args(w):
     blocks, counts, r, s, v, senders, live = w.prepare
-    prep_args = (
+    return (
         jnp.asarray(blocks),
         jnp.asarray(counts),
         jnp.asarray(r),
@@ -42,32 +51,253 @@ def main() -> None:
         jnp.int32(w.thr_lo),
         jnp.int32(w.thr_hi),
     )
-    hz, sr, ss_, sv, signers, slive = w.seals
-    seal_args = (
+
+
+def _seal_args(w):
+    hz, r, s, v, signers, live = w.seals
+    return (
         jnp.asarray(hz),
-        jnp.asarray(sr),
-        jnp.asarray(ss_),
-        jnp.asarray(sv),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
         jnp.asarray(signers),
         jnp.asarray(w.table),
-        jnp.asarray(slive),
+        jnp.asarray(live),
         jnp.asarray(w.powers_lo),
         jnp.asarray(w.powers_hi),
         jnp.int32(w.thr_lo),
         jnp.int32(w.thr_hi),
     )
 
-    # warmup / compile + correctness gate
-    mask, reached, _, _ = quorum_certify(*prep_args)
-    smask, sreached, _, _ = seal_quorum_certify(*seal_args)
-    assert np.asarray(mask)[:N_VALIDATORS].all() and bool(np.asarray(reached))
-    assert np.asarray(smask)[:N_VALIDATORS].all() and bool(np.asarray(sreached))
+
+def differential_smoke() -> None:
+    """Tiny-batch device-vs-host oracle check, with corrupted lanes.
+
+    Gates every timed config: asserts the fused kernels' masks agree
+    lane-for-lane with the sequential host crypto path (the reference's
+    per-message Verifier semantics) before a single timing sample is taken.
+    """
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+
+    w = build_round_workload(8, corrupt_frac=0.25, seed=7)
+    mask, reached, _, _ = quorum_certify(*_prep_args(w))
+    smask, sreached, _, _ = seal_quorum_certify(*_seal_args(w))
+    n = w.n_validators
+    assert (np.asarray(mask)[:n] == w.expected_prepare_mask).all(), (
+        "device prepare mask diverges from host oracle",
+        np.asarray(mask)[:n],
+        w.expected_prepare_mask,
+    )
+    assert (np.asarray(smask)[:n] == w.expected_seal_mask).all(), (
+        "device seal mask diverges from host oracle",
+        np.asarray(smask)[:n],
+        w.expected_seal_mask,
+    )
+    # 6 of 8 valid = power 6 >= floor(2*8/3)+1 = 6 -> quorum on both phases
+    assert bool(np.asarray(reached)) and bool(np.asarray(sreached))
+
+
+def config1_happy_path() -> None:
+    """4-validator full-consensus height, real ECDSA, device vs host verify."""
+    import asyncio
+
+    from go_ibft_tpu.core import IBFT, BatchingIngress
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.verify import DeviceBatchVerifier, HostBatchVerifier
+
+    class _Null:
+        def info(self, *a):
+            pass
+
+        debug = error = info
+
+    # One-time kernel warmup: a mid-round compile would stall the event
+    # loop past the round timer (the documented node-startup step).
+    DeviceBatchVerifier(lambda h: {}).warmup()
+
+    def run_cluster(verifier_cls) -> float:
+        keys = [PrivateKey.from_seed(b"bench-c1-%d" % i) for i in range(4)]
+        powers = {k.address: 1 for k in keys}
+        src = ECDSABackend.static_validators(powers)
+        nodes = []
+
+        def gossip(message):
+            for _, ingress in nodes:
+                ingress.submit(message)
+
+        class _T:
+            def multicast(self, message):
+                gossip(message)
+
+        for k in keys:
+            core = IBFT(
+                _Null(),
+                ECDSABackend(k, src),
+                _T(),
+                batch_verifier=verifier_cls(src),
+            )
+            core.set_base_round_timeout(30.0)
+            nodes.append((core, BatchingIngress(core.add_messages)))
+
+        async def height() -> float:
+            t0 = time.perf_counter()
+            await asyncio.wait_for(
+                asyncio.gather(*(core.run_sequence(1) for core, _ in nodes)), 60
+            )
+            return (time.perf_counter() - t0) * 1e3
+
+        try:
+            elapsed = asyncio.run(height())
+        finally:
+            for core, ingress in nodes:
+                ingress.close()
+                core.messages.close()
+        for core, _ in nodes:
+            assert len(core.backend.inserted) == 1
+        return elapsed
+
+    device_ms = run_cluster(DeviceBatchVerifier)
+    host_ms = run_cluster(HostBatchVerifier)
+    _log(
+        {
+            "metric": "happy_path_4v_height_latency",
+            "value": round(device_ms, 2),
+            "unit": "ms",
+            "vs_baseline": round(host_ms / device_ms, 2),
+            "baseline": "same cluster, sequential host verifier",
+            "baseline_ms": round(host_ms, 2),
+        }
+    )
+
+
+def config3_pipelined() -> None:
+    """1000 validators x 10 height-batches, dispatches pipelined."""
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+
+    workloads = [build_round_workload(1000, height=h) for h in (1, 2)]
+    args = [(_prep_args(w), _seal_args(w)) for w in workloads]
+
+    # compile + correctness gate
+    for (pa, sa), w in zip(args, workloads):
+        mask, reached, _, _ = quorum_certify(*pa)
+        smask, sreached, _, _ = seal_quorum_certify(*sa)
+        n = w.n_validators
+        assert np.asarray(mask)[:n].all() and bool(np.asarray(reached))
+        assert np.asarray(smask)[:n].all() and bool(np.asarray(sreached))
+
+    heights = 10
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(heights):  # async dispatch: queue all, block once
+        pa, sa = args[i % len(args)]
+        outs.append(quorum_certify(*pa))
+        outs.append(seal_quorum_certify(*sa))
+    jax.block_until_ready(outs)
+    elapsed = time.perf_counter() - t0
+    verifies = 1000 * 2 * heights
+    _log(
+        {
+            "metric": "ecdsa_1000v_10h_pipelined_throughput",
+            "value": round(verifies / elapsed, 1),
+            "unit": "sig-verifies/sec/chip",
+            "vs_baseline": None,
+            "elapsed_s": round(elapsed, 3),
+        }
+    )
+
+
+def config4_bls() -> None:
+    """100-validator BLS12-381 aggregate COMMIT verification p50."""
+    try:
+        from go_ibft_tpu.bench.bls_workload import build_bls_round_workload
+        from go_ibft_tpu.ops.bls12_381 import aggregate_verify_commit
+    except ImportError:
+        _log(
+            {
+                "metric": "bls_aggregate_verify_p50_100v",
+                "value": None,
+                "unit": "ms",
+                "vs_baseline": None,
+                "note": "BLS path not built yet",
+            }
+        )
+        return
+    w = build_bls_round_workload(100)
+    ok = aggregate_verify_commit(*w.args)
+    assert bool(np.asarray(ok)), "BLS aggregate verify failed correctness gate"
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(aggregate_verify_commit(*w.args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    _log(
+        {
+            "metric": "bls_aggregate_verify_p50_100v",
+            "value": round(statistics.median(times), 3),
+            "unit": "ms",
+            "vs_baseline": round(w.host_ms / statistics.median(times), 2)
+            if w.host_ms
+            else None,
+            "baseline_ms": round(w.host_ms, 1) if w.host_ms else None,
+        }
+    )
+
+
+def config5_byzantine_mix() -> None:
+    """300 validators, 30% corrupted signatures: masking + p50."""
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+
+    w = build_round_workload(300, corrupt_frac=0.3, seed=3)
+    pa, sa = _prep_args(w), _seal_args(w)
+    n = w.n_validators
+    mask, reached, _, _ = quorum_certify(*pa)
+    smask, sreached, _, _ = seal_quorum_certify(*sa)
+    assert (np.asarray(mask)[:n] == w.expected_prepare_mask).all()
+    assert (np.asarray(smask)[:n] == w.expected_seal_mask).all()
+    # 210 valid of 300 >= floor(600/3)+1 = 201 -> still quorum
+    assert bool(np.asarray(reached)) and bool(np.asarray(sreached))
 
     times = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        m1 = quorum_certify(*prep_args)
-        m2 = seal_quorum_certify(*seal_args)
+        out = (quorum_certify(*pa), seal_quorum_certify(*sa))
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    _log(
+        {
+            "metric": "byzantine_300v_30pct_prepare_commit_p50",
+            "value": round(statistics.median(times), 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "bad_lanes_masked": int(n - w.expected_prepare_mask.sum()),
+        }
+    )
+
+
+def config2_headline() -> None:
+    """100-validator fused PREPARE+COMMIT quorum verification (north star)."""
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+
+    w = build_round_workload(100)
+    pa, sa = _prep_args(w), _seal_args(w)
+    n = w.n_validators
+
+    # warmup / compile + correctness gate
+    mask, reached, _, _ = quorum_certify(*pa)
+    smask, sreached, _, _ = seal_quorum_certify(*sa)
+    assert np.asarray(mask)[:n].all() and bool(np.asarray(reached))
+    assert np.asarray(smask)[:n].all() and bool(np.asarray(sreached))
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        m1 = quorum_certify(*pa)
+        m2 = seal_quorum_certify(*sa)
         jax.block_until_ready((m1, m2))
         times.append((time.perf_counter() - t0) * 1e3)
     p50 = statistics.median(times)
@@ -82,7 +312,7 @@ def main() -> None:
     from go_ibft_tpu.messages.helpers import extract_committed_seal
     from go_ibft_tpu.messages.wire import Proposal, View
 
-    keys = _keys(N_VALIDATORS, 0)
+    keys = _keys(100, 0)
     powers = {k.address: 1 for k in keys}
     src = ECDSABackend.static_validators(powers)
     backends = [ECDSABackend(k, src) for k in keys]
@@ -122,19 +352,29 @@ def main() -> None:
         baseline_name = "pure-Python sequential per-message verify"
         assert hm1.all() and hm2.all()
 
-    print(
-        json.dumps(
-            {
-                "metric": "prepare_commit_quorum_verify_p50_100v",
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(host_ms / p50, 2),
-                "baseline": baseline_name,
-                "baseline_ms": round(host_ms, 1),
-                "device": jax.devices()[0].platform,
-            }
-        )
+    _log(
+        {
+            "metric": "prepare_commit_quorum_verify_p50_100v",
+            "value": round(p50, 3),
+            "unit": "ms",
+            "vs_baseline": round(host_ms / p50, 2),
+            "baseline": baseline_name,
+            "baseline_ms": round(host_ms, 1),
+            "device": jax.devices()[0].platform,
+        }
     )
+
+
+def main() -> None:
+    from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    differential_smoke()
+    config1_happy_path()
+    config3_pipelined()
+    config4_bls()
+    config5_byzantine_mix()
+    config2_headline()  # headline LAST: drivers read the final JSON line
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@ delegated to a BatchVerifier draining device batches.
 from .backend import (
     Backend,
     BatchVerifier,
+    FusedBatchVerifier,
     MessageConstructor,
     Notifier,
     ValidatorBackend,
@@ -15,7 +16,7 @@ from .backend import (
 )
 from .ibft import DEFAULT_BASE_ROUND_TIMEOUT, IBFT, get_round_timeout
 from .state import SequenceState, StateName
-from .transport import LoopbackTransport, Transport
+from .transport import BatchingIngress, LoopbackTransport, Transport
 from .validator_manager import (
     Logger,
     ValidatorManager,
@@ -26,8 +27,10 @@ from .validator_manager import (
 
 __all__ = [
     "Backend",
+    "BatchingIngress",
     "BatchVerifier",
     "DEFAULT_BASE_ROUND_TIMEOUT",
+    "FusedBatchVerifier",
     "IBFT",
     "Logger",
     "LoopbackTransport",

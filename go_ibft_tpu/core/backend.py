@@ -62,8 +62,21 @@ class Verifier(Protocol):
     def is_valid_proposal_hash(self, proposal: Proposal, hash_: bytes) -> bool: ...
 
     def is_valid_committed_seal(
-        self, proposal_hash: bytes, committed_seal: CommittedSeal
-    ) -> bool: ...
+        self,
+        proposal_hash: bytes,
+        committed_seal: CommittedSeal,
+        height: Optional[int] = None,
+    ) -> bool:
+        """Seal signature recovers to ``committed_seal.signer``.
+
+        The engine always passes ``height`` (the height being finalized) so
+        implementations can ALSO enforce validator-set membership — keeping
+        the accept-set identical to the batched verifiers
+        (:meth:`BatchVerifier.verify_committed_seals`).  The reference's
+        two-argument shape (core/backend.go:50-55) is the ``height=None``
+        case.
+        """
+        ...
 
 
 class Notifier(Protocol):
@@ -107,6 +120,46 @@ class BatchVerifier(Protocol):
 
         ``height`` selects the validator set the signers must belong to.
         """
+        ...
+
+
+@runtime_checkable
+class FusedBatchVerifier(BatchVerifier, Protocol):
+    """BatchVerifier that can ALSO certify quorum on device.
+
+    The flagship fusion (SURVEY.md §2 #2/#3, ops/quorum.py): one compiled
+    program per phase returns both the validity mask and the voting-power
+    quorum verdict, so the reduction never leaves the device.  The engine
+    uses these for its PREPARE/COMMIT hot path when
+    :meth:`supports_fused` says the height's powers fit the device's exact
+    integer range; otherwise it falls back to mask-on-device +
+    big-int-quorum-on-host.
+    """
+
+    def supports_fused(self, height: int) -> bool: ...
+
+    def certify_senders(
+        self,
+        msgs: Sequence[IbftMessage],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> tuple[np.ndarray, bool]:
+        """(validity mask, quorum reached) for one view's envelopes.
+
+        ``threshold`` overrides the height's quorum size — the engine
+        passes ``quorum - proposer_power`` to credit the proposer's
+        proposal in the prepare phase (reference
+        core/validator_manager.go:99-127)."""
+        ...
+
+    def certify_seals(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> tuple[np.ndarray, bool]:
+        """(validity mask, quorum reached) for one view's committed seals."""
         ...
 
 

@@ -37,7 +37,7 @@ from ..messages.wire import (
     View,
 )
 from ..utils.metrics import set_gauge
-from .backend import Backend, BatchVerifier
+from .backend import Backend, BatchVerifier, FusedBatchVerifier
 from .state import SequenceState, StateName
 from .transport import Transport
 from .validator_manager import Logger, ValidatorManager, senders_of
@@ -126,6 +126,18 @@ class IBFT:
         else:
             self.batch_verifier = None
         self._signals: Optional[_RoundSignals] = None
+
+    def _fused_for(self, height: int) -> bool:
+        """True when the PREPARE/COMMIT phases should run the fused
+        mask+quorum device program for ``height`` (verifier implements
+        :class:`FusedBatchVerifier` and the powers fit the exact device
+        integer range)."""
+        bv = self.batch_verifier
+        return (
+            bv is not None
+            and isinstance(bv, FusedBatchVerifier)
+            and bv.supports_fused(height)
+        )
 
     # -- configuration (reference core/ibft.go:1151-1159) -------------------
 
@@ -554,7 +566,13 @@ class IBFT:
         )
 
     def _handle_prepare(self, view: View) -> bool:
-        """Drain PREPAREs; move to commit on quorum (reference core/ibft.go:855-889)."""
+        """Drain PREPAREs; move to commit on quorum (reference core/ibft.go:855-889).
+
+        With a fused device verifier this is ONE ``quorum_certify``-shaped
+        dispatch: signature recovery, membership, and the proposer-credited
+        voting-power quorum all in a single compiled program."""
+        if self._fused_for(view.height):
+            return self._handle_prepare_fused(view)
 
         def is_valid_prepare(message: IbftMessage) -> bool:
             proposal = self.state.proposal
@@ -583,16 +601,85 @@ class IBFT:
         )
         return True
 
+    def _handle_prepare_fused(self, view: View) -> bool:
+        """Fused prepare-phase check (reference core/ibft.go:855-889 +
+        validator_manager.go:99-127 collapsed into one device program).
+
+        Envelope signatures are re-verified here in the same program that
+        answers the quorum question (defense in depth over the ingress
+        check — one batched dispatch, no per-message host work), and the
+        quorum threshold is pre-credited with the proposer's power on host
+        (exact ints), so the device comparison stays exact.
+        """
+        proposal = self.state.proposal
+        proposal_message = self.state.proposal_message
+        if proposal is None or proposal_message is None:
+            return False
+        snapshot = self.messages.snapshot_view(view, MessageType.PREPARE)
+        if not snapshot:
+            return False
+
+        candidates: list[IbftMessage] = []
+        invalid: list[IbftMessage] = []
+        for message in snapshot:
+            if self.backend.is_valid_proposal_hash(
+                proposal, helpers.extract_prepare_hash(message) or b""
+            ):
+                candidates.append(message)
+            else:
+                invalid.append(message)
+
+        proposer = proposal_message.sender
+        threshold = self.validator_manager.quorum_size - self.validator_manager.power_of(
+            proposer
+        )
+        assert isinstance(self.batch_verifier, FusedBatchVerifier)
+        mask, reached = self.batch_verifier.certify_senders(
+            candidates, view.height, threshold=threshold
+        )
+        valid: list[IbftMessage] = []
+        for message, ok in zip(candidates, mask):
+            (valid if bool(ok) else invalid).append(message)
+        if invalid:
+            self.messages.remove_messages(view, MessageType.PREPARE, invalid)
+
+        # The proposer multicasting its own PREPARE is a protocol violation
+        # and voids the quorum (reference core/validator_manager.go:117-124).
+        if any(message.sender == proposer for message in valid):
+            self.log.error("has_prepare_quorum: proposer is among prepare signers")
+            return False
+        if not reached:
+            return False
+
+        self._send_commit_message(view)
+        self.log.debug("commit message multicasted")
+
+        self.state.finalize_prepare(
+            PreparedCertificate(
+                proposal_message=proposal_message,
+                prepare_messages=valid,
+            ),
+            proposal,
+        )
+        return True
+
     def _handle_commit(self, view: View) -> bool:
         """Drain COMMITs; move to fin on quorum (reference core/ibft.go:931-967).
 
         With a batch verifier, this is the TPU hot path: all seals for the
         view are verified in one device call instead of one Verifier call per
-        message under the store lock.
+        message under the store lock; a fused verifier additionally answers
+        the voting-power quorum in the SAME program (``seal_quorum_certify``
+        semantics), so the reduction never leaves the device.
         """
-        commit_messages = self._drain_valid_commits(view)
-        if not self._has_quorum_by_msg_type(commit_messages, MessageType.COMMIT):
-            return False
+        if self._fused_for(view.height) and self.state.proposal is not None:
+            commit_messages, reached = self._drain_valid_commits_fused(view)
+            if not reached:
+                return False
+        else:
+            commit_messages = self._drain_valid_commits(view)
+            if not self._has_quorum_by_msg_type(commit_messages, MessageType.COMMIT):
+                return False
 
         try:
             commit_seals = helpers.extract_committed_seals(commit_messages)
@@ -620,7 +707,7 @@ class IBFT:
                 ):
                     return False
                 return self.backend.is_valid_committed_seal(
-                    proposal_hash or b"", committed_seal
+                    proposal_hash or b"", committed_seal, view.height
                 )
 
             return self.messages.get_valid_messages(
@@ -629,13 +716,31 @@ class IBFT:
 
         # Batched path: snapshot, one host pass for the (cheap, cacheable)
         # hash equality, one device batch for the (expensive) seal sigs.
-        snapshot = self.messages.snapshot_view(view, MessageType.COMMIT)
-        if not snapshot:
-            return []
+        candidates, invalid = self._collect_commit_candidates(view, proposal)
+        valid_messages: list[IbftMessage] = []
+        if candidates:
+            # All candidates share the proposal hash (hash check passed), so
+            # one batch per view suffices.
+            mask = self.batch_verifier.verify_committed_seals(
+                candidates[0][1],
+                [seal for _, _, seal in candidates],
+                view.height,
+            )
+            valid_messages = self._partition_by_mask(candidates, mask, invalid)
 
+        if invalid:
+            self.messages.remove_messages(view, MessageType.COMMIT, invalid)
+        return valid_messages
+
+    def _collect_commit_candidates(
+        self, view: View, proposal: Optional[Proposal]
+    ) -> tuple[list[tuple[IbftMessage, bytes, CommittedSeal]], list[IbftMessage]]:
+        """Snapshot the view's COMMITs and split into hash-valid candidates
+        (message, hash, seal) vs invalid messages (shared by the batched and
+        fused drains so their pruning semantics cannot diverge)."""
         candidates: list[tuple[IbftMessage, bytes, CommittedSeal]] = []
         invalid: list[IbftMessage] = []
-        for message in snapshot:
+        for message in self.messages.snapshot_view(view, MessageType.COMMIT):
             proposal_hash = helpers.extract_commit_hash(message)
             committed_seal = helpers.extract_committed_seal(message)
             if (
@@ -647,25 +752,39 @@ class IBFT:
                 invalid.append(message)
                 continue
             candidates.append((message, proposal_hash or b"", committed_seal))
+        return candidates, invalid
 
+    @staticmethod
+    def _partition_by_mask(candidates, mask, invalid) -> list[IbftMessage]:
         valid_messages: list[IbftMessage] = []
+        for (message, _, _), ok in zip(candidates, mask):
+            if bool(ok):
+                valid_messages.append(message)
+            else:
+                invalid.append(message)
+        return valid_messages
+
+    def _drain_valid_commits_fused(self, view: View) -> tuple[list[IbftMessage], bool]:
+        """One ``seal_quorum_certify`` dispatch: seal validity mask AND the
+        voting-power quorum verdict from a single device program
+        (reference core/ibft.go:931-967 + validator_manager HasQuorum)."""
+        candidates, invalid = self._collect_commit_candidates(
+            view, self.state.proposal
+        )
+        valid_messages: list[IbftMessage] = []
+        reached = False
         if candidates:
-            # All candidates share the proposal hash (hash check passed), so
-            # one batch per view suffices.
-            mask = self.batch_verifier.verify_committed_seals(
+            assert isinstance(self.batch_verifier, FusedBatchVerifier)
+            mask, reached = self.batch_verifier.certify_seals(
                 candidates[0][1],
                 [seal for _, _, seal in candidates],
                 view.height,
             )
-            for (message, _, _), ok in zip(candidates, mask):
-                if bool(ok):
-                    valid_messages.append(message)
-                else:
-                    invalid.append(message)
+            valid_messages = self._partition_by_mask(candidates, mask, invalid)
 
         if invalid:
             self.messages.remove_messages(view, MessageType.COMMIT, invalid)
-        return valid_messages
+        return valid_messages, reached
 
     def _all_senders_valid(self, msgs: Sequence[IbftMessage]) -> bool:
         """IsValidValidator over a message set — batched when possible."""
@@ -882,18 +1001,17 @@ class IBFT:
         """
         if not batch:
             return
+        gated = [m for m in batch if self._gate_height_round(m)]
         if self.batch_verifier is not None:
-            mask = self.batch_verifier.verify_senders(list(batch))
-            accepted = [m for m, ok in zip(batch, mask) if bool(ok)]
+            mask = self.batch_verifier.verify_senders(gated)
+            accepted = [m for m, ok in zip(gated, mask) if bool(ok)]
         else:
-            accepted = [m for m in batch if self.backend.is_valid_validator(m)]
+            accepted = [m for m in gated if self.backend.is_valid_validator(m)]
 
         # Store everything first, then signal once per (view, type) key —
         # signaling mid-batch could find quorum incomplete and never re-check.
         to_signal: dict[tuple[int, int, int], tuple[View, object]] = {}
         for message in accepted:
-            if not self._gate_height_round(message):
-                continue
             self.messages.add_message(message)
             if message.view is not None:
                 key = (message.view.height, message.view.round, int(message.type))
@@ -911,11 +1029,19 @@ class IBFT:
             self.messages.signal_event(message_type, view)
 
     def _is_acceptable_message(self, message: IbftMessage) -> bool:
-        """Inbound acceptance gate (reference core/ibft.go:1126-1149)."""
-        # sender signature + validator-set membership (embedder crypto)
-        if not self.backend.is_valid_validator(message):
+        """Inbound acceptance gate (reference core/ibft.go:1126-1149).
+
+        Signature verification is NEVER deferred past the store: the store
+        dedups by (type, height, round, sender) with last-write-wins, so an
+        unverified message with a forged ``sender`` field could evict a
+        validator's genuine stored message and break round liveness.  Batch
+        ingress (:meth:`add_messages`) keeps the same gate, just amortized
+        over one device call per burst.
+        """
+        if not self._gate_height_round(message):
             return False
-        return self._gate_height_round(message)
+        # sender signature + validator-set membership (embedder crypto)
+        return self.backend.is_valid_validator(message)
 
     def _gate_height_round(self, message: IbftMessage) -> bool:
         if message.view is None:

@@ -12,7 +12,8 @@ tensors) — plug into the same ``Transport`` protocol.
 
 from __future__ import annotations
 
-from typing import Callable, Protocol
+import asyncio
+from typing import Callable, Optional, Protocol, Sequence
 
 from ..messages.wire import IbftMessage
 
@@ -48,3 +49,57 @@ class LoopbackTransport:
         for idx, deliver in enumerate(self._receivers):
             if self.should_deliver(message, idx):
                 deliver(message)
+
+
+class BatchingIngress:
+    """Inbound micro-batcher: the TPU-native ingress shape.
+
+    Gossip delivers messages one at a time; verifying each eagerly costs one
+    device launch (or one host recover) per message — the reference's
+    sequential AddMessage shape (core/ibft.go:1101-1123).  This collects a
+    burst and flushes it through :meth:`IBFT.add_messages`, so sender
+    signatures for the whole burst are verified in ONE device batch.
+
+    Flushes when ``max_batch`` messages accumulate or ``max_delay`` seconds
+    after the first buffered message, whichever comes first.  Event-loop
+    affine (call :meth:`submit` from the loop thread); ``flush`` may be
+    called directly for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        add_messages: Callable[[Sequence[IbftMessage]], None],
+        *,
+        max_batch: int = 256,
+        max_delay: float = 0.002,
+    ) -> None:
+        self._add_messages = add_messages
+        self._buffer: list[IbftMessage] = []
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+
+    def submit(self, message: IbftMessage) -> None:
+        self._buffer.append(message)
+        if len(self._buffer) >= self.max_batch:
+            self.flush()
+        elif self._handle is None:
+            self._handle = asyncio.get_running_loop().call_later(
+                self.max_delay, self.flush
+            )
+
+    def flush(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if not self._buffer:
+            return
+        batch, self._buffer = self._buffer, []
+        self._add_messages(batch)
+
+    def close(self) -> None:
+        """Drop buffered messages and cancel the pending flush timer."""
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        self._buffer.clear()

@@ -89,6 +89,13 @@ class ValidatorManager:
         with self._lock:
             return self._quorum_size
 
+    def power_of(self, address: bytes) -> int:
+        """Voting power of one validator (0 for unknowns / before init)."""
+        with self._lock:
+            if self._voting_power is None:
+                return 0
+            return self._voting_power.get(address, 0)
+
     def has_quorum(self, sender_addresses: Iterable[bytes]) -> bool:
         """True when the senders' combined power reaches quorum
         (reference core/validator_manager.go:77-96).

@@ -185,7 +185,10 @@ class ECDSABackend:
         return proposal_hash_of(proposal) == hash_
 
     def is_valid_committed_seal(
-        self, proposal_hash: bytes, committed_seal: CommittedSeal
+        self,
+        proposal_hash: bytes,
+        committed_seal: CommittedSeal,
+        height: Optional[int] = None,
     ) -> bool:
         if len(committed_seal.signature) != SIG_BYTES or len(proposal_hash) != 32:
             return False
@@ -198,9 +201,14 @@ class ECDSABackend:
         )
         if pub is None:
             return False
-        # Signer must match and belong to the current validator set; the
-        # engine checks seals at the height it is finalizing.
-        return ec.pubkey_to_address(*pub) == committed_seal.signer
+        if ec.pubkey_to_address(*pub) != committed_seal.signer:
+            return False
+        # Membership: same rule as HostBatchVerifier/DeviceBatchVerifier —
+        # the signer must belong to the validator set of the height being
+        # finalized (the engine always supplies it).
+        if height is not None:
+            return committed_seal.signer in self._validators(height)
+        return True
 
     # -- ValidatorBackend / Notifier / misc -----------------------------
 

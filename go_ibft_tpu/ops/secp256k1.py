@@ -204,33 +204,32 @@ _WINDOW = 4
 _NWIN = 64  # 256 / 4
 
 
-def _precompute_g_comb() -> Tuple[np.ndarray, np.ndarray]:
-    """Fixed-base comb tables: entry [j][d] = (d * 16**j) * G, affine.
+def _precompute_g_table() -> Tuple[np.ndarray, np.ndarray]:
+    """Fixed-base window table: entry [d] = d * G, affine, d in 1..15.
 
-    Computed once at import with host integer arithmetic (~50ms); the
-    tables are tiny ((64, 16, 20) int32 x 2 ~= 160 KB) and close over the
-    jit as constants, so the ladder pays ZERO doublings for the G term.
+    Computed once at import with host integer arithmetic; the table is tiny
+    ((16, 20) int32 x 2) and closes over the jit as a constant.  The
+    ladder's four shared doublings per scan step supply the ``16**j``
+    scaling for BOTH scalar terms, so the G entries must NOT be pre-scaled
+    by ``16**j`` — a pre-scaled comb riding the same ladder would scale the
+    G term by ``16**j`` twice (regression: ``ecmul2_base(16, 0, G)`` must
+    equal ``16*G``, not ``256*G``).
     """
     from ..crypto import ecdsa as _host
 
     from .fields import to_limbs
 
-    gx_tab = np.zeros((_NWIN, 16, _L), dtype=np.int32)
-    gy_tab = np.zeros((_NWIN, 16, _L), dtype=np.int32)
-    base = (GX, GY)
-    for j in range(_NWIN):
-        pt = None
-        for d in range(1, 16):
-            pt = _host._add(pt, base)
-            gx_tab[j, d] = to_limbs([pt[0]], _L)[0]
-            gy_tab[j, d] = to_limbs([pt[1]], _L)[0]
-        # base <- 16**(j+1) * G
-        for _ in range(4):
-            base = _host._add(base, base)
+    gx_tab = np.zeros((16, _L), dtype=np.int32)
+    gy_tab = np.zeros((16, _L), dtype=np.int32)
+    pt = None
+    for d in range(1, 16):
+        pt = _host._add(pt, (GX, GY))
+        gx_tab[d] = to_limbs([pt[0]], _L)[0]
+        gy_tab[d] = to_limbs([pt[1]], _L)[0]
     return gx_tab, gy_tab
 
 
-_G_COMB_X, _G_COMB_Y = _precompute_g_comb()
+_G_TAB_X, _G_TAB_Y = _precompute_g_table()
 
 # Static nibble-extraction indices: bit position 4j may straddle a 13-bit
 # limb boundary; precompute (limb, shift, need-hi) per window.
@@ -264,14 +263,14 @@ def _one_hot_select(sel: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
 def ecmul2_base(
     k1: jnp.ndarray, k2: jnp.ndarray, qx: jnp.ndarray, qy: jnp.ndarray
 ) -> JacobianPoint:
-    """Windowed double-scalar multiply: ``k1*G + k2*Q``.
+    """Windowed double-scalar multiply: ``k1*G + k2*Q`` (Shamir/Straus).
 
     4-bit interleaved windows over a 64-step ``lax.scan``: 4 shared
     doublings per step, one *mixed* add from the precomputed fixed-base
-    comb (zero doublings ever spent on G), and one Jacobian add from the
-    per-batch 16-entry Q table.  Everything is branch-free and scan-free
-    inside the step body (see fields.is_zero_fast) — the hottest loop of
-    the framework.
+    ``d*G`` window table (the shared doublings supply the ``16**j``
+    scaling), and one Jacobian add from the per-batch 16-entry Q table.
+    Everything is branch-free and scan-free inside the step body (see
+    fields.is_zero_fast) — the hottest loop of the framework.
 
     ``k1``/``k2`` are semi-reduced scalars mod N; ``qx``/``qy`` affine
     field elements.
@@ -297,22 +296,18 @@ def ecmul2_base(
         _scalar_nibbles_msb(fields.canon(ORDER, k2)), (_NWIN,) + batch
     )
 
+    g_tab_x = jnp.asarray(_G_TAB_X)  # (16, L) d*G entries, constant
+    g_tab_y = jnp.asarray(_G_TAB_Y)
+
     def body(acc, inp):
-        d1, d2, gx_row, gy_row = inp  # gx_row: (16, L) comb entries for this j
+        d1, d2 = inp
         # 4 shared doublings (doubling infinity is safe: Z stays 0)
         acc = point_double(point_double(point_double(point_double(acc))))
-        # G term: mixed add of comb entry (skip when digit == 0)
-        gxe = jnp.einsum(
-            "...k,kl->...l",
-            (jnp.arange(16) == d1[..., None]).astype(gx_row.dtype),
-            gx_row,
+        # G term: mixed add of d1*G from the fixed window table (skip when
+        # digit == 0)
+        with_g = point_add_mixed(
+            acc, _one_hot_select(d1, g_tab_x), _one_hot_select(d1, g_tab_y)
         )
-        gye = jnp.einsum(
-            "...k,kl->...l",
-            (jnp.arange(16) == d1[..., None]).astype(gy_row.dtype),
-            gy_row,
-        )
-        with_g = point_add_mixed(acc, gxe, gye)
         acc = _sel_pt(d1 == 0, acc, with_g)
         # Q term: full Jacobian add from the per-batch table (T[0] = inf is
         # handled by point_add's completeness)
@@ -322,13 +317,7 @@ def ecmul2_base(
         acc = point_add(acc, addq)
         return acc, None
 
-    xs = (
-        n1,
-        n2,
-        jnp.asarray(_G_COMB_X[::-1].copy()),  # MSB window first
-        jnp.asarray(_G_COMB_Y[::-1].copy()),
-    )
-    acc, _ = jax.lax.scan(body, point_infinity(batch), xs)
+    acc, _ = jax.lax.scan(body, point_infinity(batch), (n1, n2))
     return acc
 
 

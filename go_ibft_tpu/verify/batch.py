@@ -20,12 +20,14 @@ for committed seals.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, List, Mapping, Sequence, Tuple
+import time
+from typing import Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.validator_manager import calculate_quorum
 from ..crypto import ecdsa as host_ecdsa
 from ..crypto.keccak import keccak256
 from ..messages.helpers import CommittedSeal
@@ -34,15 +36,16 @@ from ..ops import fields
 from ..ops import keccak as dk
 from ..ops import quorum
 from ..ops import secp256k1 as sec
+from ..utils import metrics
 
 SIG_BYTES = 65  # r(32) || s(32) || v(1)
 
 ADDRESS_BYTES = 20
 
 # Pad-to buckets: batch lanes, keccak blocks per message, validator-set size.
-_BATCH_BUCKETS = (8, 32, 128, 512, 2048)
+_BATCH_BUCKETS = (8, 32, 128, 512, 1024, 2048)
 _BLOCK_BUCKETS = (1, 2, 4, 8, 16, 32)
-_TABLE_BUCKETS = (8, 32, 128, 512, 2048)
+_TABLE_BUCKETS = (8, 32, 128, 512, 1024, 2048)
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -133,6 +136,22 @@ def _recover_kernel(zw, r, s, v, claimed_w, table_w, live):
     ok = quorum.sig_checks_zw(zw, r, s, v, claimed_w, live)
     member = jnp.any(quorum.membership_eq(claimed_w, table_w), axis=-1)
     return ok & member
+
+
+@jax.jit
+def _certify_kernel(zw, r, s, v, claimed_w, table_w, live, plo, phi, thr_lo, thr_hi):
+    """Fused mask + voting-power quorum in ONE program (the engine's hot
+    path): recovery ladder, membership, and the power reduction of
+    :func:`go_ibft_tpu.ops.quorum.power_reduce` never leave the device.
+    Serves both envelope senders (``zw`` = payload digests) and committed
+    seals (``zw`` = broadcast proposal hash), like :func:`_recover_kernel`.
+    ``thr_lo``/``thr_hi`` are traced scalars, so per-call thresholds (e.g.
+    the prepare-phase proposer credit) do not recompile."""
+    ok = quorum.sig_checks_zw(zw, r, s, v, claimed_w, live)
+    eq = quorum.membership_eq(claimed_w, table_w)
+    ok = ok & jnp.any(eq, axis=-1)
+    reached, lo, hi = quorum.power_reduce(ok, eq, plo, phi, thr_lo, thr_hi)
+    return ok, reached, lo, hi
 
 
 def _pack_scalars(values: List[int], pad_to: int) -> jnp.ndarray:
@@ -235,7 +254,10 @@ class DeviceBatchVerifier:
 
         enable_persistent_cache()
         self._validators = validators_for_height
-        self._tables: Dict[int, Tuple[np.ndarray, int]] = {}
+        self._tables: Dict[int, Tuple[np.ndarray, List[bytes]]] = {}
+        self._quorum_packs: Dict[
+            int, Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]
+        ] = {}
         self._cache_heights = cache_heights
 
     def warmup(
@@ -262,6 +284,21 @@ class DeviceBatchVerifier:
                 jnp.zeros((table_rows, 5), jnp.uint32),
                 jnp.zeros((bb,), bool),
             ).block_until_ready()
+            jax.block_until_ready(
+                _certify_kernel(
+                    zw,
+                    jnp.zeros((bb, 20), jnp.int32),
+                    jnp.zeros((bb, 20), jnp.int32),
+                    jnp.zeros((bb,), jnp.int32),
+                    jnp.zeros((bb, 5), jnp.uint32),
+                    jnp.zeros((table_rows, 5), jnp.uint32),
+                    jnp.zeros((bb,), bool),
+                    jnp.zeros((table_rows,), jnp.int32),
+                    jnp.zeros((table_rows,), jnp.int32),
+                    jnp.int32(1),
+                    jnp.int32(0),
+                )
+            )
             for nb in blocks:
                 _digest_kernel(
                     jnp.zeros((bb, nb, 17, 2), jnp.uint32),
@@ -270,15 +307,188 @@ class DeviceBatchVerifier:
 
     # -- validator table management ------------------------------------
 
-    def _table(self, height: int) -> np.ndarray:
+    def _table_and_addrs(self, height: int) -> Tuple[np.ndarray, List[bytes]]:
+        """Packed address table + the filtered address list its rows follow
+        (one build + one cache for both the mask and fused-quorum paths)."""
         hit = self._tables.get(height)
         if hit is not None:
-            return hit[0]
-        table = pack_validator_table(list(self._validators(height)))
-        self._tables[height] = (table, table.shape[0])
+            return hit
+        addrs = [
+            a for a in self._validators(height) if len(a) == ADDRESS_BYTES
+        ]
+        table = pack_validator_table(addrs)
+        self._tables[height] = (table, addrs)
         if len(self._tables) > self._cache_heights:
             self._tables.pop(min(self._tables))
-        return table
+        return table, addrs
+
+    def _table(self, height: int) -> np.ndarray:
+        return self._table_and_addrs(height)[0]
+
+    def _quorum_pack(
+        self, height: int
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]:
+        """Per-height fused-quorum arrays: (table, powers_lo, powers_hi,
+        quorum), or None when the device quorum path cannot represent the
+        set exactly (power >= 2**31, total >= 2**31, or set larger than the
+        biggest table bucket) — callers then fall back to host big-int
+        quorum (the exactness contract of ops/quorum.py)."""
+        if height in self._quorum_packs:
+            return self._quorum_packs[height]
+        powers_map = self._validators(height)
+        pack: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]] = None
+        try:
+            table, addrs = self._table_and_addrs(height)
+        except ValueError:  # empty validator set
+            addrs = []
+        # Quorum must match the host ValidatorManager exactly: the total is
+        # over the FULL voting-power map (a malformed address can never
+        # match a sender, but its power still raises the threshold).
+        total = sum(powers_map.values())
+        if (
+            addrs
+            and 0 < total < (1 << 31)
+            and all(0 <= p < (1 << 31) for p in powers_map.values())
+        ):
+            v = table.shape[0]
+            plo = np.zeros(v, dtype=np.int32)
+            phi = np.zeros(v, dtype=np.int32)
+            for i, a in enumerate(addrs):
+                plo[i], phi[i] = quorum.split_power(powers_map[a])
+            pack = (table, plo, phi, calculate_quorum(total))
+        self._quorum_packs[height] = pack
+        if len(self._quorum_packs) > self._cache_heights:
+            self._quorum_packs.pop(min(self._quorum_packs))
+        return pack
+
+    def supports_fused(self, height: int) -> bool:
+        """True when the fused mask+quorum device path is exact for this
+        height's validator set."""
+        return self._quorum_pack(height) is not None
+
+    # -- shared pack/dispatch scaffolding -------------------------------
+    # One implementation of the idxs-filter -> pack -> kernel -> unpack ->
+    # metrics pipeline serves all four public entry points, so the fused
+    # and non-fused masks can never drift apart.
+
+    @staticmethod
+    def _well_formed_sender(m: IbftMessage, height: Optional[int]) -> bool:
+        return (
+            m.view is not None
+            and (height is None or m.view.height == height)
+            and len(m.sender) == ADDRESS_BYTES
+            and len(m.signature) == SIG_BYTES
+        )
+
+    @staticmethod
+    def _well_formed_seal(seal: CommittedSeal) -> bool:
+        return (
+            len(seal.signer) == ADDRESS_BYTES
+            and len(seal.signature) == SIG_BYTES
+        )
+
+    def _dispatch(self, inputs, table, quorum_args, metric: str):
+        """Run the recover (mask-only) or certify (mask+quorum) kernel.
+
+        ``inputs`` = (zw, r, s, v, claimed, live) numpy/jax arrays;
+        ``quorum_args`` = None for the plain mask, or (plo, phi, thr)."""
+        t0 = time.perf_counter()
+        zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
+        if quorum_args is None:
+            mask = _recover_kernel(zw, r, s, v, claimed, jnp.asarray(table), live)
+            reached = None
+        else:
+            plo, phi, thr = quorum_args
+            mask, reached_dev, _, _ = _certify_kernel(
+                zw,
+                r,
+                s,
+                v,
+                claimed,
+                jnp.asarray(table),
+                live,
+                jnp.asarray(plo),
+                jnp.asarray(phi),
+                jnp.int32(max(thr, 0) & 0xFFFF),
+                jnp.int32(max(thr, 0) >> 16),
+            )
+            reached = bool(np.asarray(reached_dev))
+        mask = np.asarray(mask)
+        metrics.observe(
+            ("go-ibft", "device", metric), (time.perf_counter() - t0) * 1e3
+        )
+        return mask, reached
+
+    def _sender_inputs(self, msgs: List[IbftMessage]):
+        blocks, counts, r, s, v, senders, live = pack_sender_batch(msgs)
+        zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
+        return zw, r, s, v, senders, live
+
+    def _seal_inputs(self, proposal_hash: bytes, seals: List[CommittedSeal]):
+        return pack_seal_batch(proposal_hash, seals)
+
+    # -- fused mask + quorum (the engine's phase hot path) --------------
+
+    def _fused_pack(self, height: int, threshold: Optional[int]):
+        pack = self._quorum_pack(height)
+        if pack is None:
+            raise ValueError(f"fused quorum unsupported for height {height}")
+        table, plo, phi, quorum_size = pack
+        thr = quorum_size if threshold is None else threshold
+        return table, (plo, phi, thr), thr
+
+    def certify_senders(
+        self, msgs: Sequence[IbftMessage], height: int, threshold: Optional[int] = None
+    ) -> Tuple[np.ndarray, bool]:
+        """One device program: envelope recovery + membership + voting-power
+        quorum (ops/quorum.py ``quorum_certify`` semantics).  All messages
+        must share ``height``.  ``threshold`` overrides the quorum size
+        (the engine passes ``quorum - proposer_power`` for the prepare
+        phase's proposer credit); ``None`` means the height's quorum.
+
+        Returns ``(mask, reached)``; requires :meth:`supports_fused`.
+        """
+        table, qargs, thr = self._fused_pack(height, threshold)
+        out = np.zeros(len(msgs), dtype=bool)
+        idxs = [
+            i
+            for i, m in enumerate(msgs)
+            if self._well_formed_sender(m, height)
+        ]
+        if not idxs:
+            return out, thr <= 0
+        mask, reached = self._dispatch(
+            self._sender_inputs([msgs[i] for i in idxs]),
+            table,
+            qargs,
+            "certify_senders_ms",
+        )
+        out[np.asarray(idxs)] = mask[: len(idxs)]
+        return out, reached
+
+    def certify_seals(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool]:
+        """Fused COMMIT-phase check: seal recovery + membership + quorum in
+        one device program (ops/quorum.py ``seal_quorum_certify``
+        semantics).  Returns ``(mask, reached)``."""
+        table, qargs, thr = self._fused_pack(height, threshold)
+        out = np.zeros(len(seals), dtype=bool)
+        idxs = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
+        if not idxs or len(proposal_hash) != 32:
+            return out, thr <= 0
+        mask, reached = self._dispatch(
+            self._seal_inputs(proposal_hash, [seals[i] for i in idxs]),
+            table,
+            qargs,
+            "certify_seals_ms",
+        )
+        out[np.asarray(idxs)] = mask[: len(idxs)]
+        return out, reached
 
     # -- BatchVerifier protocol ----------------------------------------
 
@@ -288,63 +498,30 @@ class DeviceBatchVerifier:
         out = np.zeros(len(msgs), dtype=bool)
         by_height: Dict[int, List[int]] = {}
         for i, m in enumerate(msgs):
-            if (
-                m.view is not None
-                and len(m.sender) == ADDRESS_BYTES
-                and len(m.signature) == SIG_BYTES
-            ):
+            if self._well_formed_sender(m, None):
                 by_height.setdefault(m.view.height, []).append(i)
         for height, idxs in by_height.items():
-            mask = self._verify_senders_same_height(
-                [msgs[i] for i in idxs], height
+            mask, _ = self._dispatch(
+                self._sender_inputs([msgs[i] for i in idxs]),
+                self._table(height),
+                None,
+                "verify_senders_ms",
             )
-            out[np.asarray(idxs)] = mask
+            out[np.asarray(idxs)] = mask[: len(idxs)]
         return out
-
-    def _verify_senders_same_height(
-        self, msgs: List[IbftMessage], height: int
-    ) -> np.ndarray:
-        n = len(msgs)
-        blocks, counts, r, s, v, senders, live = pack_sender_batch(msgs)
-        table = self._table(height)
-        zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
-        mask = _recover_kernel(
-            zw,
-            jnp.asarray(r),
-            jnp.asarray(s),
-            jnp.asarray(v),
-            jnp.asarray(senders),
-            jnp.asarray(table),
-            jnp.asarray(live),
-        )
-        return np.asarray(mask)[:n]
 
     def verify_committed_seals(
         self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
     ) -> np.ndarray:
-        if not seals:
-            return np.zeros(0, dtype=bool)
-        n = len(seals)
-        out = np.zeros(n, dtype=bool)
-        idxs = [
-            i
-            for i, seal in enumerate(seals)
-            if len(seal.signer) == ADDRESS_BYTES and len(seal.signature) == SIG_BYTES
-        ]
+        out = np.zeros(len(seals), dtype=bool)
+        idxs = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
         if not idxs or len(proposal_hash) != 32:
             return out
-        hash_zw, r, s, v, signers, live = pack_seal_batch(
-            proposal_hash, [seals[i] for i in idxs]
+        mask, _ = self._dispatch(
+            self._seal_inputs(proposal_hash, [seals[i] for i in idxs]),
+            self._table(height),
+            None,
+            "verify_seals_ms",
         )
-        table = self._table(height)
-        mask = _recover_kernel(
-            jnp.asarray(hash_zw),
-            jnp.asarray(r),
-            jnp.asarray(s),
-            jnp.asarray(v),
-            jnp.asarray(signers),
-            jnp.asarray(table),
-            jnp.asarray(live),
-        )
-        out[np.asarray(idxs)] = np.asarray(mask)[: len(idxs)]
+        out[np.asarray(idxs)] = mask[: len(idxs)]
         return out

@@ -1,15 +1,25 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+Forces JAX onto a virtual 8-device CPU mesh BEFORE the backend initializes,
 so multi-chip sharding tests (tp/dp/sp over a Mesh) run without TPU hardware.
 Mirrors the reference's CI posture of running the full conformance suite on
 plain CPU runners (.github/workflows/main.yml).
+
+Platform selection is EXPLICIT, not env-based: some environments pre-set
+``JAX_PLATFORMS`` (and re-pin it from sitecustomize hooks), so
+``os.environ.setdefault`` silently loses.  Only
+``jax.config.update("jax_platforms", ...)`` before backend init is
+authoritative.  Opt in to running the device suites on real hardware with
+``GO_IBFT_TPU_TESTS=1 pytest ...`` (the platform the suite actually ran on
+is printed in the header and asserted).
 """
 
 import os
 
-# Must happen before any `import jax` in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_WANT_TPU = os.environ.get("GO_IBFT_TPU_TESTS", "") == "1"
+_WANT_PLATFORM = None if _WANT_TPU else "cpu"
+
+# Virtual 8-device CPU mesh: must be in place before the backend initializes.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,12 +31,42 @@ import inspect  # noqa: E402
 
 import pytest  # noqa: E402
 
-# Persistent XLA compilation cache: the crypto kernels (256-step EC ladders)
-# take minutes to compile on CPU the first time; cache makes reruns cheap.
 import jax  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_go_ibft_tpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+if _WANT_PLATFORM is not None:
+    jax.config.update("jax_platforms", _WANT_PLATFORM)
+
+# Persistent XLA compilation cache: the crypto kernels (256-step EC ladders)
+# take minutes to compile on CPU the first time; cache makes reruns cheap.
+# Shared with bench/__graft_entry__ via the same helper + default dir.
+from go_ibft_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+
+# Initialize the backend NOW and fail loudly if the platform is not the one
+# this suite selected (catches any future env/sitecustomize interference).
+_PLATFORM = jax.devices()[0].platform
+if _WANT_PLATFORM is not None and _PLATFORM != _WANT_PLATFORM:
+    raise RuntimeError(
+        f"test platform is {_PLATFORM!r}, wanted {_WANT_PLATFORM!r} — "
+        "jax backend initialized before conftest pinned it"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: large one-time kernel compiles (persistently cached); "
+        "deselect with -m 'not slow' for the fast conformance tier",
+    )
+
+
+def pytest_report_header(config):
+    return (
+        f"jax platform: {_PLATFORM} ({len(jax.devices())} devices)"
+        + ("" if _WANT_TPU else " [pinned cpu; GO_IBFT_TPU_TESTS=1 for device runs]")
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -180,7 +180,7 @@ class MockBackend:
     def is_valid_proposal_hash(self, proposal, hash_):
         return self.is_valid_proposal_hash_fn(proposal, hash_)
 
-    def is_valid_committed_seal(self, proposal_hash, committed_seal):
+    def is_valid_committed_seal(self, proposal_hash, committed_seal, height=None):
         return self.is_valid_committed_seal_fn(proposal_hash, committed_seal)
 
     # ValidatorBackend

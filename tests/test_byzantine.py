@@ -153,6 +153,43 @@ async def test_byzantine_bad_hash_proposal_and_bad_round_change():
     await _progress_with_byzantine(cluster, mutate, forced_rc=True)
 
 
+async def test_byzantine_bad_round_change_and_bad_round_proposal():
+    """+1 round in RCC and in proposal (reference byzantine_test.go:153)."""
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_preprepare_fn = _bad_round_preprepare(node)
+        node.backend.build_round_change_fn = _bad_round_round_change(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_bad_round_change_and_bad_hash_prepare():
+    """+1 round in RCC and bad hash in prepare (reference byzantine_test.go:223)."""
+    cluster = Cluster(6)
+
+    def mutate(node):
+        node.backend.build_prepare_fn = _bad_hash_prepare(node)
+        node.backend.build_round_change_fn = _bad_round_round_change(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
+async def test_byzantine_bad_round_change_and_bad_commit_seal():
+    """+1 round in RCC and bad commit seal (reference byzantine_test.go:258)."""
+    cluster = Cluster(6)
+    for node in cluster.nodes:
+        node.backend.is_valid_committed_seal_fn = (
+            lambda proposal_hash, seal: seal.signature == VALID_COMMITTED_SEAL
+        )
+
+    def mutate(node):
+        node.backend.build_commit_fn = _bad_seal_commit(node)
+        node.backend.build_round_change_fn = _bad_round_round_change(node)
+
+    await _progress_with_byzantine(cluster, mutate, forced_rc=True)
+
+
 async def test_byzantine_bad_commit_seal():
     cluster = Cluster(6)
     # Stricter than the reference mock (which accepts any seal): enforce seal

@@ -76,6 +76,33 @@ def test_round_timeout_math(base, additional, round_, expected):
     assert get_round_timeout(base, additional, round_) == expected
 
 
+async def test_extend_round_timeout_through_running_round():
+    """extend_round_timeout must stretch a LIVE round's timer — the round
+    change fires at base*2^r + additional, not at base*2^r (reference pins
+    the math through running rounds, core/ibft_test.go:3066-3099 +
+    ExtendRoundTimeout core/ibft.go:1152-1155)."""
+    ibft, backend, transport = make_ibft(proposer=b"node-1")
+    ibft.set_base_round_timeout(0.2)
+    ibft.extend_round_timeout(0.4)  # round 0 timer: 0.2 + 0.4 = 0.6s
+
+    task = asyncio.create_task(ibft.run_sequence(0))
+    try:
+        # Past the un-extended timeout, before the extended one: still quiet.
+        await asyncio.sleep(0.35)
+        assert not any(
+            m.type == MessageType.ROUND_CHANGE for m in transport.sent
+        ), "round expired at the un-extended timeout"
+        # Past the extended timeout: the round change must have fired.
+        await asyncio.sleep(0.45)
+        assert any(
+            m.type == MessageType.ROUND_CHANGE for m in transport.sent
+        ), "extended timer never fired"
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        ibft.messages.close()
+
+
 # -- new round: proposer path (reference ibft_test.go:218) -------------------
 
 

@@ -88,6 +88,25 @@ def test_ecmul2_base(points):
     assert got == expected
 
 
+def test_ecmul2_window_scaling_regression(points):
+    """The round-1 comb bug: G-table entries pre-scaled by 16^j ALSO rode
+    the ladder's per-step doublings, so ecmul2_base(16, 0, G) returned
+    256*G.  Scalars touching exactly one non-zero window above window 0
+    pin the single-scaling invariant."""
+    pts, J = points
+    ks = [16, 1 << 8, 1 << 252, 0]
+    got = unpack_affine(
+        sec.ecmul2_base(pack(ks), pack([0, 0, 0, 1]), J.x, J.y)
+    )
+    expected = [
+        host.scalar_mul(16, (host.GX, host.GY)),
+        host.scalar_mul(1 << 8, (host.GX, host.GY)),
+        host.scalar_mul(1 << 252, (host.GX, host.GY)),
+        pts[3],
+    ]
+    assert got == expected
+
+
 def test_ecmul2_zero_scalars(points):
     pts, J = points
     zeros = pack([0] * 4)

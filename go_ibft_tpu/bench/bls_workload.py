@@ -1,0 +1,84 @@
+"""BLS aggregate-COMMIT workload builder (BASELINE.md config #4).
+
+Produces the packed device arrays for
+:func:`go_ibft_tpu.ops.bls12_381.aggregate_verify_commit` plus a host
+baseline timing (the pure-python oracle pairing) for ``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..crypto import bls as hbls
+from ..ops import bls12_381 as dev
+
+_key_cache: Dict[Tuple[int, int], list] = {}
+
+
+def _bls_keys(n: int, seed: int) -> list:
+    hit = _key_cache.get((n, seed))
+    if hit is None:
+        hit = [
+            hbls.BLSPrivateKey.from_seed(b"bls-bench-%d-%d" % (seed, i))
+            for i in range(n)
+        ]
+        _key_cache[(n, seed)] = hit
+    return hit
+
+
+@dataclass
+class BLSRoundWorkload:
+    n_validators: int
+    args: tuple  # positional args for aggregate_verify_commit
+    host_ms: float  # host oracle single aggregate-verify wall time
+
+
+def build_bls_round_workload(
+    n_validators: int, *, seed: int = 0, time_host: bool = True
+) -> BLSRoundWorkload:
+    keys = _bls_keys(n_validators, seed)
+    message = b"bls bench proposal hash %d" % seed
+    # pad the message to a 32-byte "proposal hash" shape
+    message = (message + b"\x00" * 32)[:32]
+    sigs = [k.sign(message) for k in keys]
+    pubkeys = [k.pubkey for k in keys]
+
+    host_ms = 0.0
+    if time_host:
+        t0 = time.perf_counter()
+        assert hbls.aggregate_verify(
+            pubkeys, message, hbls.aggregate_signatures(sigs)
+        )
+        host_ms = (time.perf_counter() - t0) * 1e3
+
+    v = 1
+    while v < n_validators:
+        v *= 2
+    v = max(v, 2)
+    pad = v - n_validators
+    pk_x, pk_y = dev.pack_g1_points(pubkeys + [None] * pad)
+    sx0, sx1, sy0, sy1 = dev.pack_g2_points(sigs + [None] * pad)
+    h = hbls.hash_to_g2(message)
+    hx0, hx1, hy0, hy1 = dev.pack_g2_points([h])
+    live = np.zeros(v, dtype=bool)
+    live[:n_validators] = True
+    args = (
+        pk_x,
+        pk_y,
+        sx0,
+        sx1,
+        sy0,
+        sy1,
+        hx0[0],
+        hx1[0],
+        hy0[0],
+        hy1[0],
+        live,
+    )
+    return BLSRoundWorkload(
+        n_validators=n_validators, args=args, host_ms=host_ms
+    )

@@ -1,0 +1,689 @@
+"""BLS12-381 aggregate verification on TPU (JAX/XLA).
+
+BASELINE.md config #4: one pairing equation certifies a whole quorum of
+COMMIT seals — ``e(G1, sum(sig_i)) == e(sum(pk_i), H2(m))`` — replacing the
+reference's per-message committed-seal loop (go-ibft core/ibft.go:931-944
+driving Backend.IsValidCommittedSeal once per seal) with two aggregations,
+two Miller loops and ONE final exponentiation on device.
+
+Structure (everything over :mod:`.bls_fp`'s Montgomery Fp/Fp2):
+
+* towers Fp6 = Fp2[v]/(v^3 - xi), Fp12 = Fp6[w]/(w^2 - v), xi = 1 + u —
+  identical to the host oracle (:mod:`go_ibft_tpu.crypto.bls`), whose
+  exact-int arithmetic is the differential reference for every stage;
+* G1 (Fp) and G2 (Fp2) Jacobian point ops with branchless complete
+  addition (selects, never Python control flow), used by log-depth masked
+  TREE aggregation over the validator axis — the aggregation is the only
+  O(V) work, the pairing cost is independent of validator count;
+* the ate Miller loop over the 63 bits of |x| as one ``lax.scan`` (line
+  add-steps are computed branchlessly and selected in — 6 of 63 bits are
+  set, trading ~2x runtime for one compiled body);
+* final exponentiation via the easy part + the 2020/875 hard-part chain
+  ``(x-1)^2 (x+p)(x^2+p^2-1) + 3`` (identity verified against python ints
+  at import), cyclotomic inverses as conjugation, Frobenius via
+  precomputed ``xi^(k(p^n-1)/6)`` constants;
+* the verification equation is checked as
+  ``final_exp(m1 * m2^-1) == 1`` — one final exp for both pairings; line
+  scalings by Fp2 subfield factors cancel under the final exponentiation,
+  which is why device Miller values are only comparable to the host after
+  it (tests compare ``final_exp3(device) == host_pairing**3``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import bls as host
+from . import bls_fp as fp
+from .bls_fp import F2, FV, RN_BOUND, P
+
+__all__ = [
+    "G2Jac",
+    "g1_aggregate",
+    "g2_aggregate",
+    "miller_loop",
+    "final_exp3",
+    "f12_eq_one",
+    "aggregate_verify_commit",
+    "pack_g1_points",
+    "pack_g2_points",
+]
+
+BLS_X = host.BLS_X  # |x|; the parameter is negative
+
+# -- Fp6 / Fp12 -------------------------------------------------------------
+
+
+class F6(NamedTuple):
+    c0: F2
+    c1: F2
+    c2: F2
+
+
+class F12(NamedTuple):
+    c0: F6
+    c1: F6
+
+
+F6_ZERO = F6(fp.F2_ZERO, fp.F2_ZERO, fp.F2_ZERO)
+F6_ONE = F6(fp.F2_ONE, fp.F2_ZERO, fp.F2_ZERO)
+F12_ONE = F12(F6_ONE, F6_ZERO)
+
+
+def f6_add(a: F6, b: F6) -> F6:
+    return F6(fp.f2_add(a.c0, b.c0), fp.f2_add(a.c1, b.c1), fp.f2_add(a.c2, b.c2))
+
+
+def f6_sub(a: F6, b: F6) -> F6:
+    return F6(fp.f2_sub(a.c0, b.c0), fp.f2_sub(a.c1, b.c1), fp.f2_sub(a.c2, b.c2))
+
+
+def f6_neg(a: F6) -> F6:
+    return F6(fp.f2_neg(a.c0), fp.f2_neg(a.c1), fp.f2_neg(a.c2))
+
+
+def f6_renorm(a: F6) -> F6:
+    return F6(
+        fp.f2_renorm(a.c0), fp.f2_renorm(a.c1), fp.f2_renorm(a.c2)
+    )
+
+
+def f6_mul(a: F6, b: F6) -> F6:
+    t0, t1, t2 = fp.f2_mul(a.c0, b.c0), fp.f2_mul(a.c1, b.c1), fp.f2_mul(a.c2, b.c2)
+    c0 = fp.f2_add(
+        t0,
+        fp.f2_mul_xi(
+            fp.f2_sub(
+                fp.f2_mul(fp.f2_add(a.c1, a.c2), fp.f2_add(b.c1, b.c2)),
+                fp.f2_add(t1, t2),
+            )
+        ),
+    )
+    c1 = fp.f2_add(
+        fp.f2_sub(
+            fp.f2_mul(fp.f2_add(a.c0, a.c1), fp.f2_add(b.c0, b.c1)),
+            fp.f2_add(t0, t1),
+        ),
+        fp.f2_mul_xi(t2),
+    )
+    c2 = fp.f2_add(
+        fp.f2_sub(
+            fp.f2_mul(fp.f2_add(a.c0, a.c2), fp.f2_add(b.c0, b.c2)),
+            fp.f2_add(t0, t2),
+        ),
+        t1,
+    )
+    return F6(c0, c1, c2)
+
+
+def f6_mul_v(a: F6) -> F6:
+    return F6(fp.f2_mul_xi(a.c2), a.c0, a.c1)
+
+
+def f6_inv(a: F6) -> F6:
+    c0 = fp.f2_sub(fp.f2_sqr(a.c0), fp.f2_mul_xi(fp.f2_mul(a.c1, a.c2)))
+    c1 = fp.f2_sub(fp.f2_mul_xi(fp.f2_sqr(a.c2)), fp.f2_mul(a.c0, a.c1))
+    c2 = fp.f2_sub(fp.f2_sqr(a.c1), fp.f2_mul(a.c0, a.c2))
+    t = fp.f2_add(
+        fp.f2_mul(a.c0, c0),
+        fp.f2_mul_xi(
+            fp.f2_add(fp.f2_mul(a.c1, c2), fp.f2_mul(a.c2, c1))
+        ),
+    )
+    tinv = fp.f2_inv(t)
+    return F6(fp.f2_mul(c0, tinv), fp.f2_mul(c1, tinv), fp.f2_mul(c2, tinv))
+
+
+def f12_mul(a: F12, b: F12) -> F12:
+    t0 = f6_mul(a.c0, b.c0)
+    t1 = f6_mul(a.c1, b.c1)
+    c0 = f6_add(t0, f6_mul_v(t1))
+    c1 = f6_sub(f6_mul(f6_add(a.c0, a.c1), f6_add(b.c0, b.c1)), f6_add(t0, t1))
+    # Renorm outputs: keeps bounds flat across arbitrarily long chains
+    # (Miller loop, final exp) so the FV trace-time asserts stay satisfied.
+    return F12(f6_renorm(c0), f6_renorm(c1))
+
+
+def f12_sqr(a: F12) -> F12:
+    return f12_mul(a, a)
+
+
+def f12_inv(a: F12) -> F12:
+    t = f6_inv(f6_sub(f6_mul(a.c0, a.c0), f6_mul_v(f6_mul(a.c1, a.c1))))
+    return F12(f6_renorm(f6_mul(a.c0, t)), f6_renorm(f6_neg(f6_mul(a.c1, t))))
+
+
+def f12_renorm(a: F12) -> F12:
+    return F12(f6_renorm(a.c0), f6_renorm(a.c1))
+
+
+def f12_select(cond, a: F12, b: F12) -> F12:
+    return jax.tree_util.tree_map(
+        lambda x, y: fp.select(cond, x, y) if isinstance(x, FV) else x,
+        a,
+        b,
+        is_leaf=lambda n: isinstance(n, FV),
+    )
+
+
+# -- Frobenius --------------------------------------------------------------
+# w-basis: f = sum_k e_k w^k with e_0=c0.c0, e_1=c1.c0, e_2=c0.c1,
+# e_3=c1.c1, e_4=c0.c2, e_5=c1.c2.  pi^n(f) = sum conj^n(e_k) gamma_{n,k} w^k
+# with gamma_{n,k} = xi^(k (p^n - 1) / 6), computed with the host oracle's
+# exact Fp2 arithmetic at import.
+
+
+def _gamma(n: int):
+    out = []
+    for k in range(6):
+        e = k * (host.P**n - 1) // 6
+        acc = host.F2_ONE
+        base = (1, 1)  # xi = 1 + u
+        for bit in bin(e)[2:]:
+            acc = host.f2_sqr(acc)
+            if bit == "1":
+                acc = host.f2_mul(acc, base)
+        out.append(acc)
+    return out
+
+
+_GAMMA1 = _gamma(1)
+_GAMMA2 = _gamma(2)
+_GAMMA6 = _gamma(6)
+# Conjugation f^(p^6) must be exactly c1-negation in this tower:
+assert _GAMMA6[0] == (1, 0)
+assert all(_GAMMA6[k] == ((1, 0) if k % 2 == 0 else (host.P - 1, 0)) for k in range(6))
+# p^2 Frobenius coefficients are real (no conjugation):
+assert all(g[1] == 0 for g in _GAMMA2)
+
+
+def _gamma_const(g) -> F2:
+    return fp.f2_const(g[0], g[1])
+
+
+def f12_conj(a: F12) -> F12:
+    """f^(p^6): negate the odd w-powers (verified against _GAMMA6 above)."""
+    return F12(a.c0, f6_neg(a.c1))
+
+
+def _ek(a: F12, k: int) -> F2:
+    six = [a.c0.c0, a.c1.c0, a.c0.c1, a.c1.c1, a.c0.c2, a.c1.c2]
+    return six[k]
+
+
+def _from_ek(e) -> F12:
+    return F12(F6(e[0], e[2], e[4]), F6(e[1], e[3], e[5]))
+
+
+def f12_frob(a: F12, n: int) -> F12:
+    gam = {1: _GAMMA1, 2: _GAMMA2}[n]
+    es = []
+    for k in range(6):
+        e = _ek(a, k)
+        if n % 2 == 1:
+            e = fp.f2_conj(e)
+        es.append(fp.f2_mul(e, _gamma_const(gam[k])))
+    return _from_ek(es)
+
+
+# -- fixed-exponent Fp12 powers (scan over public bits) ---------------------
+
+
+def _f12_arrs(a: F12):
+    return [v.arr for v in jax.tree_util.tree_leaves(a, is_leaf=lambda n: isinstance(n, FV))]
+
+
+def _f12_from_arrs(arrs, template: F12) -> F12:
+    leaves = jax.tree_util.tree_leaves(template, is_leaf=lambda n: isinstance(n, FV))
+    rebuilt = [FV(arr, RN_BOUND) for arr, _ in zip(arrs, leaves)]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template, is_leaf=lambda n: isinstance(n, FV)),
+        rebuilt,
+    )
+
+
+def f12_pow_fixed(a: F12, exponent: int) -> F12:
+    """a**exponent (public exponent) via an MSB-first square-and-multiply
+    scan; operand arrays are carried raw and rewrapped with the static
+    RN_BOUND each step (FV bounds cannot ride a scan carrier)."""
+    assert exponent > 0
+    a = f12_renorm(a)
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        [(exponent >> i) & 1 for i in range(nbits - 2, -1, -1)], dtype=bool
+    )
+
+    def body(arrs, bit):
+        acc = _f12_from_arrs(arrs, a)
+        acc = f12_sqr(acc)
+        withm = f12_mul(acc, a)
+        sel = jax.tree_util.tree_map(
+            lambda x, y: fp.select(
+                jnp.broadcast_to(bit, x.arr.shape[:-1]), y, x
+            ),
+            acc,
+            withm,
+            is_leaf=lambda n: isinstance(n, FV),
+        )
+        return _f12_arrs(sel), None
+
+    out, _ = jax.lax.scan(body, _f12_arrs(a), bits)
+    return _f12_from_arrs(out, a)
+
+
+def exp_by_neg_x(a: F12) -> F12:
+    """a^x for the (negative) curve parameter, valid in the cyclotomic
+    subgroup where inversion is conjugation."""
+    return f12_conj(f12_pow_fixed(a, BLS_X))
+
+
+# -- G1 (Fp) and G2 (Fp2) Jacobian ops --------------------------------------
+
+
+class G1Jac(NamedTuple):
+    x: FV
+    y: FV
+    z: FV
+
+
+class G2Jac(NamedTuple):
+    x: F2
+    y: F2
+    z: F2
+
+
+def _jac_ops(F):
+    """Field-generic complete Jacobian double/add (a = 0 curves), the
+    branchless-select structure proven in ops/secp256k1.py."""
+
+    def double(p):
+        a = F.sqr(p.x)
+        b = F.sqr(p.y)
+        c = F.sqr(b)
+        t = F.sqr(F.add(p.x, b))
+        d = F.muli(F.sub(F.sub(t, a), c), 2)
+        e = F.muli(a, 3)
+        ff = F.sqr(e)
+        x3 = F.sub(ff, F.muli(d, 2))
+        y3 = F.sub(F.mul(e, F.sub(d, x3)), F.muli(c, 8))
+        z3 = F.muli(F.mul(p.y, p.z), 2)
+        return type(p)(F.renorm(x3), F.renorm(y3), F.renorm(z3))
+
+    def add_complete(p, q):
+        z1s = F.sqr(p.z)
+        z2s = F.sqr(q.z)
+        u1 = F.mul(p.x, z2s)
+        u2 = F.mul(q.x, z1s)
+        s1 = F.mul(p.y, F.mul(z2s, q.z))
+        s2 = F.mul(q.y, F.mul(z1s, p.z))
+        h = F.sub(u2, u1)
+        r = F.sub(s2, s1)
+        hs = F.sqr(h)
+        hc = F.mul(hs, h)
+        u1hs = F.mul(u1, hs)
+        x3 = F.sub(F.sub(F.sqr(r), hc), F.muli(u1hs, 2))
+        y3 = F.sub(F.mul(r, F.sub(u1hs, x3)), F.mul(s1, hc))
+        z3 = F.mul(F.mul(p.z, q.z), h)
+        generic = type(p)(F.renorm(x3), F.renorm(y3), F.renorm(z3))
+
+        same_x = F.is_zero(h)
+        same_y = F.is_zero(r)
+        dbl = double(p)
+        out = _sel_pt(F, same_x & same_y, dbl, generic)
+        out = _sel_pt(F, F.is_zero(p.z), q, out)
+        out = _sel_pt(F, F.is_zero(q.z), p, out)
+        return out
+
+    return double, add_complete
+
+
+def _sel_pt(F, cond, a, b):
+    return type(a)(
+        F.sel(cond, a.x, b.x), F.sel(cond, a.y, b.y), F.sel(cond, a.z, b.z)
+    )
+
+
+class _FpOps:
+    add = staticmethod(fp.add)
+    sub = staticmethod(fp.sub)
+    mul = staticmethod(fp.mul)
+    muli = staticmethod(fp.muli)
+    sel = staticmethod(fp.select)
+    renorm = staticmethod(fp.renorm_to)
+
+    @staticmethod
+    def sqr(a):
+        return fp.mul(a, a)
+
+    @staticmethod
+    def is_zero(a):
+        return fp.is_zero(fp.renorm(a) if a.bound > 8 * P else a)
+
+
+class _Fp2Ops:
+    add = staticmethod(fp.f2_add)
+    sub = staticmethod(fp.f2_sub)
+    mul = staticmethod(fp.f2_mul)
+    muli = staticmethod(fp.f2_muli)
+    sel = staticmethod(fp.f2_select)
+    is_zero = staticmethod(fp.f2_is_zero)
+
+    @staticmethod
+    def sqr(a):
+        return fp.f2_sqr(a)
+
+    @staticmethod
+    def renorm(a):
+        return F2(fp.renorm_to(a.c0), fp.renorm_to(a.c1))
+
+
+_g1_double, _g1_add = _jac_ops(_FpOps)
+_g2_double, _g2_add = _jac_ops(_Fp2Ops)
+
+
+def _tree_reduce(points, point_add, n: int):
+    """Log-depth masked sum: fold the leading (power-of-two) axis."""
+    assert n and (n & (n - 1)) == 0, "pad validator axis to a power of two"
+
+    def fvmap(fn, tree):
+        return jax.tree_util.tree_map(
+            lambda v: FV(fn(v.arr), v.bound),
+            tree,
+            is_leaf=lambda x: isinstance(x, FV),
+        )
+
+    while n > 1:
+        n //= 2
+        half = n
+        lo = fvmap(lambda a: a[:half], points)
+        hi = fvmap(lambda a: a[half:], points)
+        points = point_add(lo, hi)
+    return fvmap(lambda a: a[0], points)
+
+
+def g1_aggregate(xs: FV, ys: FV, live) -> G1Jac:
+    """Masked sum of affine G1 points over the leading axis (power of 2)."""
+    n = xs.arr.shape[0]
+    one = FV(jnp.broadcast_to(jnp.asarray(fp.ONE.arr), xs.arr.shape), fp.ONE.bound)
+    z = fp.select(live, one, FV(jnp.zeros_like(xs.arr), 1))
+    pts = G1Jac(xs, ys, z)
+    return _tree_reduce(pts, _g1_add, n)
+
+
+def g2_aggregate(xs: F2, ys: F2, live) -> G2Jac:
+    n = xs.c0.arr.shape[0]
+    one_arr = jnp.broadcast_to(jnp.asarray(fp.ONE.arr), xs.c0.arr.shape)
+    zero_arr = jnp.zeros_like(xs.c0.arr)
+    z = F2(
+        fp.select(live, FV(one_arr, fp.ONE.bound), FV(zero_arr, 1)),
+        FV(zero_arr, 1),
+    )
+    pts = G2Jac(xs, ys, z)
+    return _tree_reduce(pts, _g2_add, n)
+
+
+def jac_to_affine_g1(p: G1Jac) -> Tuple[FV, FV]:
+    zinv = fp.inv(fp.renorm(p.z))
+    zi2 = fp.mul(zinv, zinv)
+    return fp.mul(p.x, zi2), fp.mul(p.y, fp.mul(zi2, zinv))
+
+
+def jac_to_affine_g2(p: G2Jac) -> Tuple[F2, F2]:
+    zinv = fp.f2_inv(_Fp2Ops.renorm(p.z))
+    zi2 = fp.f2_sqr(zinv)
+    return fp.f2_mul(p.x, zi2), fp.f2_mul(p.y, fp.f2_mul(zi2, zinv))
+
+
+# -- Miller loop ------------------------------------------------------------
+
+
+def _sparse_line(e0: F2, e3: F2, e5: F2) -> F12:
+    """Line element in the w-basis slots (0, 3, 5) — see the derivation in
+    the module docstring of how the M-twist untwisting lands there."""
+    zero_like = F2(
+        FV(jnp.zeros_like(e0.c0.arr), 1), FV(jnp.zeros_like(e0.c0.arr), 1)
+    )
+    return _from_ek([e0, zero_like, zero_like, e3, zero_like, e5])
+
+
+def _dbl_step(T: G2Jac, xP: FV, yP: FV):
+    """Tangent line at T evaluated at P, plus 2T.
+
+    Line (scaled by the subfield factor 2*Y*Z^3*xi, legal under final exp):
+    e0 = -2 yP xi Y Z^3, e3 = 2 Y^2 - 3 X^3, e5 = 3 xP X^2 Z^2.
+    """
+    X, Y, Z = T.x, T.y, T.z
+    z2 = fp.f2_sqr(Z)
+    z3 = fp.f2_mul(z2, Z)
+    yz3 = fp.f2_mul(Y, z3)
+    e0 = fp.f2_neg(fp.f2_muli(fp.f2_mul_xi(_f2_mul_fp(yz3, yP)), 2))
+    y2 = fp.f2_sqr(Y)
+    x2 = fp.f2_sqr(X)
+    x3 = fp.f2_mul(x2, X)
+    e3 = fp.f2_sub(fp.f2_muli(y2, 2), fp.f2_muli(x3, 3))
+    e5 = fp.f2_muli(_f2_mul_fp(fp.f2_mul(x2, z2), xP), 3)
+    return _sparse_line(e0, e3, e5), _g2_double(T)
+
+
+def _add_step(T: G2Jac, qx: F2, qy: F2, xP: FV, yP: FV):
+    """Line through T and the affine twist point Q, evaluated at P; plus
+    T + Q (mixed).  Scaled by -(Z * lambda):
+    e0 = -yP xi Z H  ->  scaled: yP xi Z H ... final scaling chosen so
+    e0 = -(yP xi) Z H is consistent with e3 = r xQ - yQ Z H, e5 = xP r
+    where H = xQ Z^2 - X, r = yQ Z^3 - Y.
+    """
+    X, Y, Z = T.x, T.y, T.z
+    z2 = fp.f2_sqr(Z)
+    z3 = fp.f2_mul(z2, Z)
+    H = fp.f2_sub(fp.f2_mul(qx, z2), X)
+    r = fp.f2_sub(fp.f2_mul(qy, z3), Y)
+    zh = fp.f2_mul(Z, H)
+    e0 = fp.f2_neg(fp.f2_mul_xi(_f2_mul_fp(zh, yP)))
+    e3 = fp.f2_sub(fp.f2_mul(r, qx), fp.f2_mul(qy, zh))
+    e5 = _f2_mul_fp(r, xP)
+
+    hs = fp.f2_sqr(H)
+    hc = fp.f2_mul(hs, H)
+    v = fp.f2_mul(X, hs)
+    x3 = fp.f2_sub(fp.f2_sub(fp.f2_sqr(r), hc), fp.f2_muli(v, 2))
+    y3 = fp.f2_sub(fp.f2_mul(r, fp.f2_sub(v, x3)), fp.f2_mul(Y, hc))
+    z3n = fp.f2_mul(Z, H)
+    Tn = G2Jac(
+        _Fp2Ops.renorm(x3), _Fp2Ops.renorm(y3), _Fp2Ops.renorm(z3n)
+    )
+    return _sparse_line(e0, e3, e5), Tn
+
+
+def _f2_mul_fp(a: F2, s: FV) -> F2:
+    return F2(fp.mul(a.c0, s), fp.mul(a.c1, s))
+
+
+_X_BITS = [int(b) for b in bin(BLS_X)[3:]]  # MSB-first, skip leading 1
+
+
+def miller_loop(qx: F2, qy: F2, px: FV, py: FV) -> F12:
+    """f_{|x|, Q}(P), conjugated for the negative parameter.
+
+    One scan over the 63 remaining bits of |x|: every step computes the
+    doubling line; add-steps are computed branchlessly and selected in on
+    the 6 set bits.
+    """
+    qx = _Fp2Ops.renorm(qx)
+    qy = _Fp2Ops.renorm(qy)
+    px = fp.renorm_to(px)
+    py = fp.renorm_to(py)
+    T0 = G2Jac(qx, qy, fp.F2_ONE)
+    T0 = G2Jac(
+        _Fp2Ops.renorm(T0.x),
+        _Fp2Ops.renorm(T0.y),
+        F2(fp.renorm_to(fp.ONE), fp.renorm_to(fp.ZERO)),
+    )
+    f0 = f12_renorm(F12_ONE)
+    # broadcast the scalar ONE/ZERO limbs to match batchless shapes
+    bits = jnp.asarray(_X_BITS, dtype=bool)
+
+    def arrs(tree):
+        return [
+            v.arr
+            for v in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda n: isinstance(n, FV)
+            )
+        ]
+
+    def rebuild(raw, template):
+        leaves = jax.tree_util.tree_leaves(
+            template, is_leaf=lambda n: isinstance(n, FV)
+        )
+        rebuilt = [FV(a, RN_BOUND) for a, _ in zip(raw, leaves)]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(
+                template, is_leaf=lambda n: isinstance(n, FV)
+            ),
+            rebuilt,
+        )
+
+    state0 = (arrs(T0), arrs(f0))
+
+    def body(state, bit):
+        t_raw, f_raw = state
+        T = rebuild(t_raw, T0)
+        f = rebuild(f_raw, f0)
+        line_d, T2 = _dbl_step(T, px, py)
+        f2_ = f12_mul(f12_sqr(f), f12_renorm(line_d))
+        line_a, T3 = _add_step(T2, qx, qy, px, py)
+        f3_ = f12_mul(f2_, f12_renorm(line_a))
+        cond = jnp.asarray(bit)
+        Tn = _sel_pt(_Fp2Ops, jnp.broadcast_to(cond, ()), T3, T2)
+        Tn = G2Jac(
+            _Fp2Ops.renorm(Tn.x), _Fp2Ops.renorm(Tn.y), _Fp2Ops.renorm(Tn.z)
+        )
+        fn = f12_select(jnp.broadcast_to(cond, ()), f3_, f2_)
+        fn = f12_renorm(fn)
+        return (arrs(Tn), arrs(fn)), None
+
+    state, _ = jax.lax.scan(body, state0, bits)
+    f = rebuild(state[1], f0)
+    return f12_conj(f)  # negative parameter
+
+
+# -- final exponentiation (cubed variant) -----------------------------------
+
+# Identity check: the 2020/875 chain computes f^(3*(p^4-p^2+1)/r).
+assert (BLS_X + 1) ** 2 * (-BLS_X + host.P) * (
+    BLS_X**2 + host.P**2 - 1
+) + 3 == 3 * ((host.P**4 - host.P**2 + 1) // host.R), "hard-part chain"
+# note: x = -BLS_X, so (x-1)^2 == (BLS_X+1)^2 and (x+p) == (p - BLS_X).
+
+
+def final_exp3(f: F12) -> F12:
+    """f^(3 * (p^12 - 1) / r): easy part then the 2020/875 chain.
+
+    The extra factor 3 (vs the canonical final exp) is a bijection on the
+    r-order target group (gcd(3, r) = 1), so equality checks are
+    unaffected; tests against the host compare ``host_result**3``.
+    """
+    # easy: f^((p^6 - 1)(p^2 + 1))
+    f = f12_mul(f12_conj(f), f12_inv(f))
+    f = f12_mul(f12_frob(f, 2), f)
+
+    # hard (cyclotomic from here): ((f^(x-1))^(x-1))^(x+p)^(x^2+p^2-1) * f^3
+    def exp_x_minus_1(g: F12) -> F12:
+        return f12_mul(exp_by_neg_x(g), f12_conj(g))
+
+    t = exp_x_minus_1(exp_x_minus_1(f))
+    t = f12_mul(exp_by_neg_x(t), f12_frob(t, 1))  # ^(x + p)
+    t2 = exp_by_neg_x(exp_by_neg_x(t))  # ^(x^2)
+    t = f12_mul(f12_mul(t2, f12_frob(t, 2)), f12_conj(t))  # ^(x^2 + p^2 - 1)
+    f3 = f12_mul(f12_sqr(f), f)
+    return f12_renorm(f12_mul(t, f3))
+
+
+def f12_eq_one(f: F12) -> jnp.ndarray:
+    """f == 1 exactly (canonical comparison at the edges)."""
+    ok = jnp.ones((), dtype=bool)
+    for k in range(6):
+        e = _ek(f, k)
+        want_one = k == 0
+        c0 = fp.canon_mod_p(fp.renorm(e.c0))
+        c1 = fp.canon_mod_p(fp.renorm(e.c1))
+        ref = jnp.asarray(fp.to_mont(1).arr) if want_one else jnp.zeros_like(c0)
+        ok = ok & jnp.all(c0 == ref, axis=-1) & jnp.all(c1 == 0, axis=-1)
+    return ok
+
+
+# -- host packing + the aggregate kernel ------------------------------------
+
+
+def pack_g1_points(points) -> Tuple[np.ndarray, np.ndarray]:
+    """Affine G1 points -> Montgomery limb arrays (infinity -> (0, 0))."""
+    xs = [0 if p is None else p[0] for p in points]
+    ys = [0 if p is None else p[1] for p in points]
+    return fp.pack_mont(xs), fp.pack_mont(ys)
+
+
+def pack_g2_points(points):
+    """Affine G2 points -> 4 Montgomery limb arrays (x0, x1, y0, y1)."""
+    x0 = [0 if p is None else p[0][0] for p in points]
+    x1 = [0 if p is None else p[0][1] for p in points]
+    y0 = [0 if p is None else p[1][0] for p in points]
+    y1 = [0 if p is None else p[1][1] for p in points]
+    return (
+        fp.pack_mont(x0),
+        fp.pack_mont(x1),
+        fp.pack_mont(y0),
+        fp.pack_mont(y1),
+    )
+
+
+_G1_GEN_X = fp.pack_mont([host.G1_GEN[0]])[0]
+_G1_GEN_Y = fp.pack_mont([host.G1_GEN[1]])[0]
+
+
+@jax.jit
+def aggregate_verify_commit(
+    pk_x,
+    pk_y,
+    sig_x0,
+    sig_x1,
+    sig_y0,
+    sig_y1,
+    h_x0,
+    h_x1,
+    h_y0,
+    h_y1,
+    live,
+):
+    """Device aggregate COMMIT verification.
+
+    ``e(G1, sum(sig_i)) == e(sum(pk_i), H2(m))`` over the live lanes.
+    Inputs: per-validator G1 pubkeys ``(V, L)``, per-validator G2 seal
+    points ``(V, L)`` x4 components, the message point H2(m) ``(L,)`` x4,
+    and the live mask ``(V,)`` (V a power of two).  Returns a scalar bool.
+
+    The whole check is ONE compiled program: two masked tree aggregations,
+    two Miller loops, one shared final exponentiation of the ratio.
+    """
+    bnd = P  # host packs canonical (< p) values
+
+    def fv(a):
+        return FV(a, bnd)
+
+    pk_agg = g1_aggregate(fv(pk_x), fv(pk_y), live)
+    sig_agg = g2_aggregate(
+        F2(fv(sig_x0), fv(sig_x1)), F2(fv(sig_y0), fv(sig_y1)), live
+    )
+    nonempty = ~fp.is_zero(fp.renorm(pk_agg.z)) & ~fp.f2_is_zero(sig_agg.z)
+
+    pk_ax, pk_ay = jac_to_affine_g1(pk_agg)
+    sig_ax, sig_ay = jac_to_affine_g2(sig_agg)
+
+    m1 = miller_loop(sig_ax, sig_ay, FV(jnp.asarray(_G1_GEN_X), bnd), FV(jnp.asarray(_G1_GEN_Y), bnd))
+    m2 = miller_loop(
+        F2(fv(h_x0), fv(h_x1)), F2(fv(h_y0), fv(h_y1)), pk_ax, pk_ay
+    )
+    ratio = f12_mul(m1, f12_inv(m2))
+    return f12_eq_one(final_exp3(ratio)) & nonempty

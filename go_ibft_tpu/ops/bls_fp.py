@@ -1,0 +1,425 @@
+"""Montgomery Fp/Fp2 arithmetic for BLS12-381 on TPU (JAX/XLA).
+
+The 381-bit BLS12-381 prime sits far below the next radix-2**13 limb
+boundary (2**390), so the pseudo-Mersenne folding of :mod:`.fields` cannot
+apply (``fields.py`` raises at Modulus construction).  This module is the
+promised Montgomery path: elements live in the Montgomery domain
+``aR mod p`` with ``R = 2**390``, multiplication is a fully *parallel*
+REDC — three schoolbook convolutions and log-depth carries, no sequential
+limb recurrence — and additions/subtractions are lazy.
+
+Correctness armor: every value is wrapped in :class:`FV`, which carries an
+EXACT Python-int upper bound on the represented value.  All ops assert
+their overflow preconditions against these bounds **at trace time** — a
+formula that could overflow int32 lanes or exceed the REDC input range
+fails loudly during ``jit`` tracing instead of silently corrupting field
+math (the round-1 lesson: quiet big-int bugs cost an entire round).
+
+Subtraction uses *bound-shaped* fat offsets: ``a - b`` becomes
+``a + F - b`` where ``F`` is the smallest multiple of p whose limbs
+dominate ``b``'s limb bounds.  Because a carried value's top limbs are
+bounded by the value itself, F costs only ~3x the subtrahend's bound —
+not the ~R/p blowup a uniform fat representation would need.
+
+Reference context: go-ibft injects all crypto via Backend
+(core/backend.go:37-56); BASELINE.md config #4 sets the BLS target.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fields import LIMB_BITS, LIMB_MASK, _carry, _conv, _ks_carry, to_limbs
+
+__all__ = [
+    "P",
+    "L",
+    "R_MONT",
+    "FV",
+    "const",
+    "to_mont",
+    "from_mont_limbs",
+    "pack_mont",
+    "add",
+    "sub",
+    "neg",
+    "mul",
+    "sqr",
+    "muli",
+    "renorm",
+    "inv",
+    "pow_p_fixed",
+    "select",
+    "is_zero",
+    "eq",
+    "canon_mod_p",
+    "f2_add",
+    "f2_sub",
+    "f2_neg",
+    "f2_mul",
+    "f2_sqr",
+    "f2_muli",
+    "f2_conj",
+    "f2_mul_xi",
+    "f2_inv",
+    "f2_select",
+    "f2_is_zero",
+    "f2_renorm",
+    "F2",
+]
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+L = 30  # ceil(381 / 13)
+R_MONT = 1 << (LIMB_BITS * L)  # 2**390
+_PPRIME = (-pow(P, -1, R_MONT)) % R_MONT  # -p^-1 mod R
+_P_LIMBS = to_limbs([P], L)[0]
+_PPRIME_LIMBS = to_limbs([_PPRIME], L)[0]
+
+ONE_M_INT = R_MONT % P  # to_mont(1)
+
+# REDC output bound: t = (z + m*p)/R with m < R*(1 + 2**-12) =>
+# t < z/R + p*(1 + 2**-12).  Inputs must satisfy z < _REDC_MAX_Z so t < 2p.
+_REDC_MAX_Z = (P - (P >> 10)) * R_MONT
+
+# Canonical "renormed" bound: every renorm_to output carries exactly this
+# bound, so lax.scan state (whose pytree must be invariant) can hold FVs.
+RN_BOUND = P + (P >> 3)
+
+# Largest value a 30-limb carried vector can represent (limbs <= 2**13).
+_NARROW_CAP = sum((LIMB_MASK + 1) << (LIMB_BITS * i) for i in range(L))
+
+
+class FV:
+    """A limb array + an exact host-side value bound.
+
+    Registered as a pytree with ``bound`` as STATIC aux data: the bound is
+    a (potentially 700-bit) Python int that exists only at trace time —
+    ``jit``/``scan`` never see it as a traced value.
+    """
+
+    __slots__ = ("arr", "bound")
+
+    def __init__(self, arr, bound: int):
+        self.arr = arr
+        self.bound = bound  # exclusive upper bound on the represented value
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"FV(shape={getattr(self.arr, 'shape', None)}, bound~2^{self.bound.bit_length()})"
+
+
+jax.tree_util.register_pytree_node(
+    FV,
+    lambda v: ((v.arr,), v.bound),
+    lambda bound, children: FV(children[0], bound),
+)
+
+
+def const(value_mod_p: int, nlimbs: int = L) -> FV:
+    """Plain (non-Montgomery) constant as an FV.
+
+    Kept as NUMPY so importing this module never initializes a JAX
+    backend; jit tracing converts the arrays to device constants."""
+    v = value_mod_p % P
+    return FV(to_limbs([v], nlimbs)[0], v + 1)
+
+
+def to_mont(value: int) -> FV:
+    """Host int -> Montgomery-domain FV constant."""
+    return const(value * R_MONT % P)
+
+
+def pack_mont(values, batch_shape=None) -> np.ndarray:
+    """Host packing: python ints -> Montgomery limb rows ``(N, L)``."""
+    return to_limbs([v * R_MONT % P for v in values], L)
+
+
+def from_mont_limbs(arr) -> list:
+    """Host unpacking: Montgomery limb rows -> python ints (exact)."""
+    from .fields import from_limbs
+
+    rinv = pow(R_MONT, -1, P)
+    return [v * rinv % P for v in from_limbs(arr)]
+
+
+ONE = to_mont(1)
+ZERO = FV(np.zeros((L,), np.int32), 1)
+
+
+# -- fat offsets for borrow-free subtraction --------------------------------
+
+_fat_cache: dict = {}
+
+
+def _fat_for(sub_bound: int, nlimbs: int) -> Tuple[np.ndarray, int]:
+    """Smallest-ish multiple of p whose limbs dominate any carried value
+    < ``sub_bound``; returns (limbs, value).  Limb caps stay < 3*2**13 so
+    ``a + F - b`` columns fit int32 with room to spare."""
+    key = (sub_bound, nlimbs)
+    hit = _fat_cache.get(key)
+    if hit is not None:
+        return hit
+    floors = [
+        min(LIMB_MASK + 1, sub_bound >> (LIMB_BITS * i)) for i in range(nlimbs)
+    ]
+    base = sum(f << (LIMB_BITS * i) for i, f in enumerate(floors))
+    cap = 3 * (LIMB_MASK + 1) - 1
+    m = -(-base // P)
+    while True:
+        value = m * P
+        rem = value - base
+        limbs = np.zeros(nlimbs, dtype=np.int32)
+        for i in range(nlimbs - 1, -1, -1):
+            unit = 1 << (LIMB_BITS * i)
+            extra = min(rem // unit, cap - floors[i])
+            limbs[i] = floors[i] + extra
+            rem -= extra * unit
+        if rem == 0:
+            _fat_cache[key] = (limbs, value)
+            return limbs, value
+        m += 1  # pragma: no cover - greedy nearly always fits on first try
+
+
+# -- narrow (30-limb) ops ---------------------------------------------------
+
+
+def add(a: FV, b: FV) -> FV:
+    out = _carry(a.arr + b.arr, 2)
+    bound = a.bound + b.bound
+    assert bound <= _NARROW_CAP, "narrow add overflow - renorm an operand"
+    return FV(out, bound)
+
+
+def sub(a: FV, b: FV) -> FV:
+    fat_limbs, fat_value = _fat_for(b.bound, L)
+    out = _carry(a.arr + jnp.asarray(fat_limbs) - b.arr, 3)
+    bound = a.bound + fat_value
+    assert bound <= _NARROW_CAP, "narrow sub overflow - renorm an operand"
+    return FV(out, bound)
+
+
+def neg(a: FV) -> FV:
+    return sub(ZERO, a)
+
+
+def muli(a: FV, k: int) -> FV:
+    assert 1 <= k <= 8
+    out = _carry(a.arr * k, 3)
+    bound = a.bound * k
+    assert bound <= _NARROW_CAP
+    return FV(out, bound)
+
+
+def _mul_wide(a: FV, b: FV) -> FV:
+    """Full product as a 61-limb lazy vector (no reduction)."""
+    z = _carry(_conv(a.arr, b.arr, 2 * L + 1), 4)
+    return FV(z, a.bound * b.bound)
+
+
+def _redc(z: FV) -> FV:
+    """Parallel Montgomery reduction: 61-limb product -> <2p, exact limbs."""
+    assert z.bound < _REDC_MAX_Z, "REDC input out of range - renorm operands"
+    arr = z.arr
+    if arr.shape[-1] < 2 * L + 2:
+        pad = [(0, 0)] * (arr.ndim - 1) + [(0, 2 * L + 2 - arr.shape[-1])]
+        arr = jnp.pad(arr, pad)
+    # m = (z mod R) * p' mod R: take the low L columns of the uncarried
+    # convolution (higher columns are multiples of R), then carry — the
+    # carry out of the top limb is dropped by _carry, which is again mod R.
+    m = _carry(
+        _conv(arr[..., :L], jnp.asarray(_PPRIME_LIMBS), 2 * L - 1)[..., :L], 4
+    )
+    u = arr + _conv(m, jnp.asarray(_P_LIMBS), 2 * L + 2)
+    u = _ks_carry(_carry(u, 4))
+    # u == z + m*p is divisible by R; exact carries make the low limbs
+    # literally zero, so the division is a slice.
+    t = u[..., L : 2 * L]
+    return FV(t, z.bound // R_MONT + P + (P >> 10))
+
+
+def mul(a: FV, b: FV) -> FV:
+    return _redc(_mul_wide(a, b))
+
+
+def sqr(a: FV) -> FV:
+    return mul(a, a)
+
+
+def renorm(a: FV) -> FV:
+    """Re-reduce a lazy accumulation to < 2p (one REDC against R mod p)."""
+    return mul(a, ONE)
+
+
+def renorm_to(a: FV) -> FV:
+    """Renorm with the FIXED bound :data:`RN_BOUND` — scan-state safe."""
+    out = mul(a, ONE)
+    assert out.bound <= RN_BOUND, out.bound
+    return FV(out.arr, RN_BOUND)
+
+
+def select(cond: jnp.ndarray, a: FV, b: FV) -> FV:
+    return FV(
+        jnp.where(cond[..., None], a.arr, b.arr), max(a.bound, b.bound)
+    )
+
+
+def _canon_exact(a: FV) -> jnp.ndarray:
+    """Exact canonical limbs in [0, p); input bound must be < 4p.
+
+    Sequential scans inside — edges only (final equality checks)."""
+    from .fields import _ge_const, _sub_exact, _exact_carry
+
+    assert a.bound <= 4 * P
+    z = _exact_carry(a.arr)
+    for _ in range(3):  # peel up to 3 multiples of p
+        ge = _ge_const(z, _P_LIMBS)
+        z = jnp.where(ge[..., None], _sub_exact(z, _P_LIMBS), z)
+    return z
+
+
+def canon_mod_p(a: FV) -> jnp.ndarray:
+    return _canon_exact(a)
+
+
+def is_zero(a: FV) -> jnp.ndarray:
+    """a === 0 (mod p), branch-free, for bounds up to 8p: the KS-canonical
+    value must equal one of the k multiples of p below the bound."""
+    assert a.bound <= 8 * P, "is_zero bound too large - renorm first"
+    c = _ks_carry(a.arr)
+    k = -(-a.bound // P)
+    hit = jnp.zeros(c.shape[:-1], dtype=bool)
+    for j in range(k + 1):
+        ref = jnp.asarray(to_limbs([j * P], L)[0])
+        hit = hit | jnp.all(c == ref, axis=-1)
+    return hit
+
+
+def eq(a: FV, b: FV) -> jnp.ndarray:
+    return is_zero(sub(renorm(a) if a.bound > 4 * P else a,
+                       renorm(b) if b.bound > 4 * P else b))
+
+
+def pow_p_fixed(a: FV, exponent: int) -> FV:
+    """Montgomery-domain fixed-exponent power via an MSB-first scan.
+
+    ``mont_pow(aR, e) == (a^e)R`` — the domain survives the ladder."""
+    assert exponent > 0
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        [(exponent >> i) & 1 for i in range(nbits - 2, -1, -1)], dtype=bool
+    )
+    a2 = renorm(a) if a.bound > 2 * P + (P >> 9) else a
+    bnd = a2.bound
+
+    def body(acc_arr, bit):
+        acc = FV(acc_arr, bnd)
+        acc = sqr(acc)
+        acc = select(jnp.broadcast_to(bit, acc.arr.shape[:-1]), mul(acc, a2), acc)
+        return acc.arr, None
+
+    out, _ = jax.lax.scan(body, a2.arr, bits)
+    return FV(out, bnd)
+
+
+def inv(a: FV) -> FV:
+    """Fermat inverse (montgomery domain in, montgomery domain out);
+    inv(0) == 0."""
+    return pow_p_fixed(a, P - 2)
+
+
+# -- Fp2 = Fp[u]/(u^2 + 1) --------------------------------------------------
+
+
+class F2(NamedTuple):
+    c0: FV
+    c1: FV
+
+
+F2_ZERO = F2(ZERO, ZERO)
+F2_ONE = F2(ONE, ZERO)
+
+
+def f2_add(a: F2, b: F2) -> F2:
+    return F2(add(a.c0, b.c0), add(a.c1, b.c1))
+
+
+def f2_sub(a: F2, b: F2) -> F2:
+    return F2(sub(a.c0, b.c0), sub(a.c1, b.c1))
+
+
+def f2_neg(a: F2) -> F2:
+    return F2(neg(a.c0), neg(a.c1))
+
+
+def f2_conj(a: F2) -> F2:
+    return F2(a.c0, neg(a.c1))
+
+
+def f2_muli(a: F2, k: int) -> F2:
+    return F2(muli(a.c0, k), muli(a.c1, k))
+
+
+def f2_mul(a: F2, b: F2) -> F2:
+    """Karatsuba with LAZY combination: the three products stay wide and the
+    (t0 - t1) / cross-term subtractions happen on 61-limb vectors, costing
+    one REDC per output component."""
+    t0 = _mul_wide(a.c0, b.c0)
+    t1 = _mul_wide(a.c1, b.c1)
+    t2 = _mul_wide(add(a.c0, a.c1), add(b.c0, b.c1))
+    fat_limbs, fat_value = _fat_for(t1.bound, 2 * L + 1)
+    c0 = _redc(
+        FV(_carry(t0.arr + jnp.asarray(fat_limbs) - t1.arr, 3), t0.bound + fat_value)
+    )
+    cross_sub = FV(
+        _carry(t0.arr + t1.arr, 2), t0.bound + t1.bound
+    )
+    fat2_limbs, fat2_value = _fat_for(cross_sub.bound, 2 * L + 1)
+    c1 = _redc(
+        FV(
+            _carry(t2.arr + jnp.asarray(fat2_limbs) - cross_sub.arr, 3),
+            t2.bound + fat2_value,
+        )
+    )
+    return F2(c0, c1)
+
+
+def f2_sqr(a: F2) -> F2:
+    """(c0+c1 u)^2 = (c0+c1)(c0-c1) + 2 c0 c1 u."""
+    s = add(a.c0, a.c1)
+    d = sub(a.c0, a.c1)
+    t = _mul_wide(a.c0, a.c1)
+    c1 = _redc(FV(_carry(t.arr * 2, 2), 2 * t.bound))
+    return F2(mul(s, d), c1)
+
+
+def f2_mul_xi(a: F2) -> F2:
+    """Multiply by xi = 1 + u: (c0 - c1) + (c0 + c1) u."""
+    return F2(sub(a.c0, a.c1), add(a.c0, a.c1))
+
+
+def f2_inv(a: F2) -> F2:
+    n = add(mul(a.c0, a.c0), mul(a.c1, a.c1))
+    ninv = inv(n)
+    return F2(mul(a.c0, ninv), neg(mul(a.c1, ninv)))
+
+
+def f2_select(cond: jnp.ndarray, a: F2, b: F2) -> F2:
+    return F2(select(cond, a.c0, b.c0), select(cond, a.c1, b.c1))
+
+
+def f2_is_zero(a: F2) -> jnp.ndarray:
+    a0 = renorm(a.c0) if a.c0.bound > 8 * P else a.c0
+    a1 = renorm(a.c1) if a.c1.bound > 8 * P else a.c1
+    return is_zero(a0) & is_zero(a1)
+
+
+def f2_renorm(a: F2) -> F2:
+    return F2(renorm(a.c0), renorm(a.c1))
+
+
+def f2_const(c0: int, c1: int) -> F2:
+    """Host ints -> Montgomery-domain Fp2 constant."""
+    return F2(to_mont(c0), to_mont(c1))

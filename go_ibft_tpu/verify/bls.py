@@ -1,0 +1,159 @@
+"""BLS12-381 aggregate committed-seal verification (BatchVerifier-shaped).
+
+BASELINE.md config #4: instead of one ECDSA recovery per COMMIT seal, the
+whole quorum is certified with ONE pairing equation —
+``e(G1, sum(sig_i)) == e(sum(pk_i), H2(proposal_hash))`` — so the COMMIT
+phase cost is two masked point aggregations plus a validator-count-
+independent pairing check.
+
+Shape of the integration (same seam as the ECDSA path,
+:class:`go_ibft_tpu.core.backend.BatchVerifier`): ``verify_committed_seals``
+returns a per-seal boolean mask.  Aggregate verification is all-or-nothing,
+so the fast path answers "all valid"; on failure it falls back to
+per-seal host verification to pinpoint the bad lanes (the standard
+aggregate-then-bisect trade: the happy path — byzantine-free rounds — is
+one pairing).
+
+Seal wire format: 192 bytes ``x0 || x1 || y0 || y1`` (uncompressed G2,
+48-byte big-endian field elements).  Validator registry maps the 20-byte
+consensus address to the BLS G1 public key.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..crypto import bls as hbls
+from ..messages.helpers import CommittedSeal
+from ..utils import metrics
+
+BLS_SEAL_BYTES = 192
+_FE = 48  # bytes per Fp element
+
+BLSKeySource = Callable[[int], Mapping[bytes, "hbls.PointG1"]]
+
+
+def encode_seal(point: "hbls.PointG2") -> bytes:
+    """G2 point -> 192-byte seal (x0 || x1 || y0 || y1, big-endian)."""
+    if point is None:
+        raise ValueError("cannot encode the point at infinity as a seal")
+    (x0, x1), (y0, y1) = point
+    return b"".join(v.to_bytes(_FE, "big") for v in (x0, x1, y0, y1))
+
+
+def decode_seal(blob: bytes) -> Optional["hbls.PointG2"]:
+    """192-byte seal -> G2 point, or None when malformed / off-curve."""
+    if len(blob) != BLS_SEAL_BYTES:
+        return None
+    x0, x1, y0, y1 = (
+        int.from_bytes(blob[i * _FE : (i + 1) * _FE], "big") for i in range(4)
+    )
+    if max(x0, x1, y0, y1) >= hbls.P:
+        return None
+    pt = ((x0, x1), (y0, y1))
+    if not hbls.g2_on_curve(pt):
+        return None
+    return pt
+
+
+class BLSAggregateVerifier:
+    """Aggregate-first committed-seal verifier.
+
+    ``bls_keys_for_height`` maps height -> {consensus address: G1 pubkey}.
+    The device path (:func:`go_ibft_tpu.ops.bls12_381.aggregate_verify_commit`)
+    runs when ``device=True``; the host oracle pairing runs otherwise —
+    identical accept-sets either way (conformance tests assert it).
+    """
+
+    def __init__(self, bls_keys_for_height: BLSKeySource, device: bool = True):
+        self._keys = bls_keys_for_height
+        self._device = device
+
+    # -- the one-pairing happy path ------------------------------------
+
+    def _aggregate_check(
+        self,
+        proposal_hash: bytes,
+        points: Sequence["hbls.PointG2"],
+        pubkeys: Sequence["hbls.PointG1"],
+    ) -> bool:
+        if self._device:
+            return self._aggregate_check_device(proposal_hash, points, pubkeys)
+        agg = hbls.aggregate_signatures(points)
+        return hbls.aggregate_verify(list(pubkeys), proposal_hash, agg)
+
+    def _aggregate_check_device(
+        self, proposal_hash, points, pubkeys
+    ) -> bool:
+        import jax.numpy as jnp
+
+        from ..ops import bls12_381 as dev
+
+        n = len(points)
+        v = 1
+        while v < n:
+            v *= 2
+        v = max(v, 2)
+        pk_x, pk_y = dev.pack_g1_points(list(pubkeys) + [None] * (v - n))
+        sx0, sx1, sy0, sy1 = dev.pack_g2_points(
+            list(points) + [None] * (v - n)
+        )
+        h = hbls.hash_to_g2(proposal_hash)
+        hx0, hx1, hy0, hy1 = dev.pack_g2_points([h])
+        live = np.zeros(v, dtype=bool)
+        live[:n] = True
+        t0 = time.perf_counter()
+        ok = dev.aggregate_verify_commit(
+            jnp.asarray(pk_x),
+            jnp.asarray(pk_y),
+            jnp.asarray(sx0),
+            jnp.asarray(sx1),
+            jnp.asarray(sy0),
+            jnp.asarray(sy1),
+            jnp.asarray(hx0[0]),
+            jnp.asarray(hx1[0]),
+            jnp.asarray(hy0[0]),
+            jnp.asarray(hy1[0]),
+            jnp.asarray(live),
+        )
+        out = bool(np.asarray(ok))
+        metrics.observe(
+            ("go-ibft", "device", "bls_aggregate_ms"),
+            (time.perf_counter() - t0) * 1e3,
+        )
+        return out
+
+    # -- BatchVerifier-shaped seal interface ---------------------------
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        out = np.zeros(len(seals), dtype=bool)
+        if not seals or len(proposal_hash) != 32:
+            return out
+        keys = self._keys(height)
+        decoded: list[Tuple[int, "hbls.PointG2", "hbls.PointG1"]] = []
+        for i, seal in enumerate(seals):
+            pk = keys.get(seal.signer)
+            if pk is None:
+                continue  # not a validator at this height
+            pt = decode_seal(seal.signature)
+            if pt is None:
+                continue  # malformed / off-curve
+            decoded.append((i, pt, pk))
+        if not decoded:
+            return out
+        idxs = [i for i, _, _ in decoded]
+        points = [p for _, p, _ in decoded]
+        pks = [k for _, _, k in decoded]
+        if self._aggregate_check(proposal_hash, points, pks):
+            out[np.asarray(idxs)] = True
+            return out
+        # Unhappy path: pinpoint bad seals one by one on host (rare —
+        # requires an actively byzantine signer inside the candidate set).
+        for i, pt, pk in decoded:
+            out[i] = hbls.verify(pk, proposal_hash, pt)
+        return out

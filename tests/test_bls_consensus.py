@@ -1,0 +1,93 @@
+"""End-to-end consensus with BLS aggregatable committed seals.
+
+4-node cluster, ECDSA envelopes + BLS G2 seals; COMMIT validity flows
+through :class:`BLSAggregateVerifier` (one pairing check per drain) and the
+finalized blocks carry seals that aggregate-verify — the whole point of
+BASELINE.md config #4.
+
+Marked slow: the aggregate kernel / host pairings dominate wall time.
+"""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.core import IBFT
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import bls as hbls
+from go_ibft_tpu.crypto.bls_backend import HybridBLSBackend, HybridBatchVerifier
+from go_ibft_tpu.verify import HostBatchVerifier
+from go_ibft_tpu.verify.bls import BLSAggregateVerifier, decode_seal
+
+from harness import NullLogger
+
+pytestmark = pytest.mark.slow
+
+
+class BLSCluster:
+    def __init__(self, n: int, device: bool = False):
+        self.ec_keys = [PrivateKey.from_seed(b"blsc-%d" % i) for i in range(n)]
+        self.bls_keys = [
+            hbls.BLSPrivateKey.from_seed(b"blsc-%d" % i) for i in range(n)
+        ]
+        self._powers = {k.address: 1 for k in self.ec_keys}
+        self._registry = {
+            ek.address: bk.pubkey
+            for ek, bk in zip(self.ec_keys, self.bls_keys)
+        }
+        self.nodes = []
+        for ek, bk in zip(self.ec_keys, self.bls_keys):
+            backend = HybridBLSBackend(
+                ek, bk, lambda h: self._powers, lambda h: self._registry
+            )
+            verifier = HybridBatchVerifier(
+                HostBatchVerifier(lambda h: self._powers),
+                BLSAggregateVerifier(lambda h: self._registry, device=device),
+            )
+            cluster = self
+
+            class _T:
+                def multicast(self, message):
+                    cluster.gossip(message)
+
+            core = IBFT(NullLogger(), backend, _T(), batch_verifier=verifier)
+            core.set_base_round_timeout(60.0)
+            self.nodes.append(core)
+
+    def gossip(self, message):
+        for node in self.nodes:
+            node.add_message(message)
+
+    async def run_height(self, height: int, timeout: float = 120.0):
+        tasks = [
+            asyncio.create_task(n.run_sequence(height)) for n in self.nodes
+        ]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tasks), timeout)
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for n in self.nodes:
+                n.messages.close()
+
+
+async def test_bls_seal_consensus_happy_path():
+    cluster = BLSCluster(4, device=False)
+    await cluster.run_height(1)
+    registry = cluster._registry
+    for node in cluster.nodes:
+        assert len(node.backend.inserted) == 1
+        proposal, seals = node.backend.inserted[0]
+        assert proposal.raw_proposal == b"block 1"
+        assert len(seals) >= 3
+        # every inserted seal is a valid BLS signature AND they aggregate
+        from go_ibft_tpu.crypto.backend import proposal_hash_of
+
+        phash = proposal_hash_of(proposal)
+        points = [decode_seal(s.signature) for s in seals]
+        assert all(p is not None for p in points)
+        pubkeys = [registry[s.signer] for s in seals]
+        agg = hbls.aggregate_signatures(points)
+        assert hbls.aggregate_verify(pubkeys, phash, agg)

@@ -1,0 +1,204 @@
+"""Device BLS12-381 vs the host oracle — stage-by-stage differentials.
+
+Every device stage (field towers, Frobenius, curve aggregation, Miller
+loop + final exponentiation, the full aggregate-verify kernel) is compared
+against the exact-integer host oracle.  Device Miller values differ from
+the host's by subfield line scalings, so pairing comparisons happen after
+final exponentiation: ``final_exp3(device) == host_pairing ** 3``.
+
+Marked ``slow``: the pairing program is a large one-time compile (cached
+persistently afterwards).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from go_ibft_tpu.crypto import bls as host
+from go_ibft_tpu.ops import bls12_381 as dev
+from go_ibft_tpu.ops import bls_fp as fp
+from go_ibft_tpu.ops.fields import from_limbs
+
+pytestmark = pytest.mark.slow
+
+_RINV = pow(fp.R_MONT, -1, fp.P)
+
+
+def unmont(fv) -> int:
+    return [
+        v * _RINV % fp.P
+        for v in from_limbs(np.asarray(fv.arr).reshape(1, -1))
+    ][0]
+
+
+def mont2(t):
+    return fp.F2(fp.to_mont(t[0]), fp.to_mont(t[1]))
+
+
+def unmont2(x):
+    return (unmont(x.c0), unmont(x.c1))
+
+
+def mont12(t):
+    return dev.F12(
+        dev.F6(*[mont2(c) for c in t[0]]), dev.F6(*[mont2(c) for c in t[1]])
+    )
+
+
+def unmont12(x):
+    return (
+        (unmont2(x.c0.c0), unmont2(x.c0.c1), unmont2(x.c0.c2)),
+        (unmont2(x.c1.c0), unmont2(x.c1.c1), unmont2(x.c1.c2)),
+    )
+
+
+def _rnd12(rng):
+    def r2():
+        return (rng.randrange(host.P), rng.randrange(host.P))
+
+    return ((r2(), r2(), r2()), (r2(), r2(), r2()))
+
+
+def test_f12_tower_matches_host():
+    import random
+
+    rng = random.Random(42)
+    a, b = _rnd12(rng), _rnd12(rng)
+    got = unmont12(jax.jit(dev.f12_mul)(mont12(a), mont12(b)))
+    assert got == host.f12_mul(a, b)
+    got = unmont12(jax.jit(dev.f12_inv)(mont12(a)))
+    assert got == host.f12_inv(a)
+    for n in (1, 2):
+        got = unmont12(jax.jit(lambda x, n=n: dev.f12_frob(x, n))(mont12(a)))
+        assert got == host.f12_pow(a, host.P**n), f"frobenius p^{n}"
+    got = unmont12(jax.jit(dev.f12_conj)(mont12(a)))
+    assert got == host.f12_pow(a, host.P**6)
+
+
+def test_g2_aggregation_matches_host():
+    pts = [host.g2_mul(k, host.G2_GEN) for k in (3, 5, 8, 11)]
+    live = np.array([True, True, False, True])
+    x0, x1, y0, y1 = dev.pack_g2_points(pts)
+
+    @jax.jit
+    def agg(x0, x1, y0, y1, live):
+        p = dev.g2_aggregate(
+            fp.F2(fp.FV(x0, fp.P), fp.FV(x1, fp.P)),
+            fp.F2(fp.FV(y0, fp.P), fp.FV(y1, fp.P)),
+            live,
+        )
+        ax, ay = dev.jac_to_affine_g2(p)
+        return (
+            fp.renorm(ax.c0).arr,
+            fp.renorm(ax.c1).arr,
+            fp.renorm(ay.c0).arr,
+            fp.renorm(ay.c1).arr,
+        )
+
+    ax0, ax1, ay0, ay1 = agg(x0, x1, y0, y1, live)
+    want = host.g2_mul(3 + 5 + 11, host.G2_GEN)
+    got = (
+        (unmont(fp.FV(ax0, fp.P)), unmont(fp.FV(ax1, fp.P))),
+        (unmont(fp.FV(ay0, fp.P)), unmont(fp.FV(ay1, fp.P))),
+    )
+    assert got == want
+
+
+def test_pairing_matches_host_cubed():
+    q = host.g2_mul(6, host.G2_GEN)
+    p = host.g1_mul(9, host.G1_GEN)
+    qx0, qx1, qy0, qy1 = dev.pack_g2_points([q])
+    px, py = dev.pack_g1_points([p])
+
+    @jax.jit
+    def pair(qx0, qx1, qy0, qy1, px, py):
+        m = dev.miller_loop(
+            fp.F2(fp.FV(qx0, fp.P), fp.FV(qx1, fp.P)),
+            fp.F2(fp.FV(qy0, fp.P), fp.FV(qy1, fp.P)),
+            fp.FV(px, fp.P),
+            fp.FV(py, fp.P),
+        )
+        return dev.final_exp3(m)
+
+    got = unmont12(pair(qx0[0], qx1[0], qy0[0], qy1[0], px[0], py[0]))
+    want = host.f12_pow(host.pairing(q, p), 3)
+    assert got == want
+
+
+def test_aggregate_verify_commit_end_to_end():
+    import jax.numpy as jnp
+
+    keys = [host.BLSPrivateKey.from_seed(b"dv-%d" % i) for i in range(3)]
+    msg = b"device aggregate proposal hash\x00\x00"[:32]
+    sigs = [k.sign(msg) for k in keys]
+    pks = [k.pubkey for k in keys]
+    h = host.hash_to_g2(msg)
+
+    def run(sigs, pks, live):
+        pad = 4 - len(sigs)
+        pk_x, pk_y = dev.pack_g1_points(pks + [None] * pad)
+        sx0, sx1, sy0, sy1 = dev.pack_g2_points(sigs + [None] * pad)
+        hx0, hx1, hy0, hy1 = dev.pack_g2_points([h])
+        return bool(
+            np.asarray(
+                dev.aggregate_verify_commit(
+                    jnp.asarray(pk_x),
+                    jnp.asarray(pk_y),
+                    jnp.asarray(sx0),
+                    jnp.asarray(sx1),
+                    jnp.asarray(sy0),
+                    jnp.asarray(sy1),
+                    jnp.asarray(hx0[0]),
+                    jnp.asarray(hx1[0]),
+                    jnp.asarray(hy0[0]),
+                    jnp.asarray(hy1[0]),
+                    jnp.asarray(np.array(live + [False] * pad)),
+                )
+            )
+        )
+
+    assert run(sigs, pks, [True] * 3)
+    # one signature swapped for a signature over a different message
+    bad = [keys[0].sign(b"evil" + b"\x00" * 28)] + sigs[1:]
+    assert not run(bad, pks, [True] * 3)
+    # mask excludes the bad lane -> remaining aggregate verifies
+    assert run(bad, pks, [False, True, True])
+    # wrong pubkey set
+    other = host.BLSPrivateKey.from_seed(b"dv-x").pubkey
+    assert not run(sigs, [other] + pks[1:], [True] * 3)
+
+
+def test_bls_aggregate_verifier_masks():
+    from go_ibft_tpu.messages.helpers import CommittedSeal
+    from go_ibft_tpu.verify.bls import BLSAggregateVerifier, encode_seal
+
+    keys = [host.BLSPrivateKey.from_seed(b"mv-%d" % i) for i in range(4)]
+    addrs = [b"addr-%02d-pad-pad-pad" % i for i in range(4)]
+    registry = dict(zip(addrs, (k.pubkey for k in keys)))
+    phash = b"\x37" * 32
+    seals = [
+        CommittedSeal(signer=a, signature=encode_seal(k.sign(phash)))
+        for a, k in zip(addrs, keys)
+    ]
+    # corruptions: signature over wrong message; non-member signer;
+    # malformed blob
+    seals.append(
+        CommittedSeal(
+            signer=addrs[0],
+            signature=encode_seal(keys[0].sign(b"\x38" * 32)),
+        )
+    )
+    outsider = host.BLSPrivateKey.from_seed(b"mv-outsider")
+    seals.append(
+        CommittedSeal(
+            signer=b"outsider-pad-pad-pad",
+            signature=encode_seal(outsider.sign(phash)),
+        )
+    )
+    seals.append(CommittedSeal(signer=addrs[1], signature=b"\x01" * 192))
+
+    for device in (False, True):
+        verifier = BLSAggregateVerifier(lambda h: registry, device=device)
+        mask = verifier.verify_committed_seals(phash, seals, height=1)
+        assert list(mask) == [True] * 4 + [False] * 3, (device, mask)

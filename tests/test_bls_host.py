@@ -1,0 +1,83 @@
+"""Host BLS12-381 oracle self-tests (pure python, no JAX).
+
+The host module is the semantics source of truth for the device path, so
+its own correctness rests on mathematical self-checks: group laws, pairing
+bilinearity, and signature round-trips (the reference injects crypto via
+Backend — core/backend.go:37-56 — so there is no upstream oracle to
+compare against).
+"""
+
+import pytest
+
+from go_ibft_tpu.crypto import bls
+
+
+def test_generators_and_orders():
+    assert bls.g1_on_curve(bls.G1_GEN)
+    assert bls.g2_on_curve(bls.G2_GEN)
+    assert bls.g1_mul(bls.R, bls.G1_GEN) is None
+    assert bls.g2_mul(bls.R, bls.G2_GEN) is None
+
+
+def test_group_laws():
+    a = bls.g1_mul(7, bls.G1_GEN)
+    b = bls.g1_mul(11, bls.G1_GEN)
+    assert bls.g1_add(a, b) == bls.g1_mul(18, bls.G1_GEN)
+    assert bls.g1_add(a, bls.g1_neg(a)) is None
+    qa = bls.g2_mul(5, bls.G2_GEN)
+    qb = bls.g2_mul(9, bls.G2_GEN)
+    assert bls.g2_add(qa, qb) == bls.g2_mul(14, bls.G2_GEN)
+    assert bls.g2_add(qa, bls.g2_neg(qa)) is None
+
+
+@pytest.fixture(scope="module")
+def base_pairing():
+    return bls.pairing(bls.G2_GEN, bls.G1_GEN)
+
+
+def test_pairing_nondegenerate_and_r_torsion(base_pairing):
+    assert base_pairing != bls.F12_ONE
+    assert bls.f12_pow(base_pairing, bls.R) == bls.F12_ONE
+
+
+def test_pairing_bilinear(base_pairing):
+    a, b = 127, 829
+    lhs = bls.pairing(bls.g2_mul(b, bls.G2_GEN), bls.g1_mul(a, bls.G1_GEN))
+    assert lhs == bls.f12_pow(base_pairing, a * b)
+
+
+def test_hash_to_g2_subgroup():
+    h = bls.hash_to_g2(b"some proposal hash")
+    assert bls.g2_on_curve(h)
+    assert bls.g2_mul(bls.R, h) is None
+    # deterministic
+    assert h == bls.hash_to_g2(b"some proposal hash")
+    assert h != bls.hash_to_g2(b"another proposal hash")
+
+
+def test_sign_verify_aggregate():
+    keys = [bls.BLSPrivateKey.from_seed(b"t-%d" % i) for i in range(4)]
+    msg = b"proposal hash xyz"
+    sigs = [k.sign(msg) for k in keys]
+    assert bls.verify(keys[0].pubkey, msg, sigs[0])
+    assert not bls.verify(keys[1].pubkey, msg, sigs[0])
+    assert not bls.verify(keys[0].pubkey, b"other", sigs[0])
+    agg = bls.aggregate_signatures(sigs)
+    pks = [k.pubkey for k in keys]
+    assert bls.aggregate_verify(pks, msg, agg)
+    assert not bls.aggregate_verify(pks[:3], msg, agg)
+    assert not bls.aggregate_verify(pks, b"other", agg)
+
+
+def test_seal_codec_roundtrip():
+    from go_ibft_tpu.verify.bls import decode_seal, encode_seal
+
+    key = bls.BLSPrivateKey.from_seed(b"codec")
+    sig = key.sign(b"m")
+    blob = encode_seal(sig)
+    assert len(blob) == 192
+    assert decode_seal(blob) == sig
+    assert decode_seal(blob[:-1]) is None
+    tampered = bytearray(blob)
+    tampered[3] ^= 1
+    assert decode_seal(bytes(tampered)) is None  # off-curve

@@ -1,0 +1,20 @@
+"""Distributed communication backends (SURVEY.md §5 "distributed
+communication backend").
+
+The reference's entire comm layer is the ``Transport.Multicast`` seam
+(go-ibft core/transport.go:7-10) with real gossip living in the embedder
+(libp2p in Polygon Edge).  This package provides the two production-shaped
+backends behind the same seam:
+
+* :class:`GrpcTransport` — asyncio gRPC fire-and-forget multicast between
+  hosts over DCN; matches the reference's async-gossip reality.
+* :class:`IciLockstepTransport` — the TPU-idiomatic simulation mode: one
+  validator per mesh device, "multicast" is an ``all_gather`` of
+  fixed-size message tensors over ICI, consensus rounds become lock-step
+  collective steps.
+"""
+
+from .grpc_transport import GrpcTransport
+from .ici import IciLockstepTransport
+
+__all__ = ["GrpcTransport", "IciLockstepTransport"]

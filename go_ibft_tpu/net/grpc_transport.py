@@ -1,0 +1,147 @@
+"""gRPC/DCN multicast transport.
+
+Implements the reference's one-method ``Transport`` seam
+(go-ibft core/transport.go:7-10) across hosts: ``multicast`` encodes the
+message once with the framework's canonical wire codec
+(:mod:`go_ibft_tpu.messages.wire`) and fire-and-forgets it to every peer
+over async gRPC; inbound bytes decode and land in the local engine's
+ingress (``add_message`` or a :class:`~go_ibft_tpu.core.transport.
+BatchingIngress` for the batched device path).
+
+No protoc codegen: the service is registered with generic bytes handlers
+(the payload already IS a canonical protobuf-compatible encoding, so a
+second serialization layer would only add bytes).  Self-delivery is
+local (the reference expects nodes to receive their own messages,
+core/transport.go:8-9) and never touches the network.
+
+Fire-and-forget semantics match the reference: delivery failures are
+logged and dropped — consensus liveness is the protocol's job (round
+changes), not the transport's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, Optional, Sequence
+
+import grpc
+
+from ..messages.wire import IbftMessage
+
+_SERVICE = "goibft.Transport"
+_METHOD = "Multicast"
+_FULL_METHOD = f"/{_SERVICE}/{_METHOD}"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class GrpcTransport:
+    """Asyncio gRPC multicast between validator hosts.
+
+    ``deliver`` receives decoded inbound messages (self-delivered ones
+    included).  Call :meth:`start` before use and :meth:`stop` on
+    shutdown.  ``peers`` maps peer name -> ``host:port`` target.
+    """
+
+    def __init__(
+        self,
+        listen_addr: str,
+        peers: Dict[str, str],
+        deliver: Callable[[IbftMessage], None],
+        logger=None,
+    ) -> None:
+        self._listen_addr = listen_addr
+        self._peers = dict(peers)
+        self._deliver = deliver
+        self._log = logger
+        self._server: Optional[grpc.aio.Server] = None
+        self._channels: Dict[str, grpc.aio.Channel] = {}
+        self._stubs: Dict[str, grpc.aio.UnaryUnaryMultiCallable] = {}
+        self._tasks: set = set()
+        self.bound_port: Optional[int] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        server = grpc.aio.server()
+
+        async def _handle(request: bytes, context) -> bytes:
+            try:
+                message = IbftMessage.decode(request)
+            except Exception as err:  # noqa: BLE001 - malformed peer input
+                if self._log:
+                    self._log.error("grpc transport: undecodable message", err)
+                return b""
+            self._deliver(message)
+            return b""
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _METHOD: grpc.unary_unary_rpc_method_handler(
+                    _handle,
+                    request_deserializer=_identity,
+                    response_serializer=_identity,
+                )
+            },
+        )
+        server.add_generic_rpc_handlers((handler,))
+        self.bound_port = server.add_insecure_port(self._listen_addr)
+        await server.start()
+        self._server = server
+        for name, target in self._peers.items():
+            channel = grpc.aio.insecure_channel(target)
+            self._channels[name] = channel
+            self._stubs[name] = channel.unary_unary(
+                _FULL_METHOD,
+                request_serializer=_identity,
+                response_deserializer=_identity,
+            )
+
+    async def stop(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for channel in self._channels.values():
+            await channel.close()
+        self._channels.clear()
+        self._stubs.clear()
+        if self._server is not None:
+            await self._server.stop(grace=0.2)
+            self._server = None
+
+    def add_peer(self, name: str, target: str) -> None:
+        channel = grpc.aio.insecure_channel(target)
+        self._channels[name] = channel
+        self._stubs[name] = channel.unary_unary(
+            _FULL_METHOD,
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    # -- Transport seam -------------------------------------------------
+
+    def multicast(self, message: IbftMessage) -> None:
+        """Encode once, self-deliver locally, fan out to all peers."""
+        payload = message.encode()
+        self._deliver(message)
+        for name, stub in self._stubs.items():
+            task = asyncio.get_running_loop().create_task(
+                self._send(name, stub, payload)
+            )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _send(self, name: str, stub, payload: bytes) -> None:
+        try:
+            await stub(payload, timeout=5.0)
+        except (grpc.aio.AioRpcError, asyncio.CancelledError) as err:
+            if self._log:
+                self._log.debug("grpc multicast to %s failed", name, err)
+
+
+def local_cluster_addresses(n: int) -> Sequence[str]:
+    """Convenience: n distinct localhost listen addresses (ephemeral)."""
+    return ["127.0.0.1:0"] * n

@@ -1,0 +1,175 @@
+"""ICI lock-step collective transport (the TPU-idiomatic cluster mode).
+
+One validator per device of a ``jax`` mesh; "multicast" buffers messages
+into the local node's fixed-shape outbox tensor, and a periodic collective
+step ``all_gather``s every node's outbox across the mesh — over ICI on
+real TPU hardware, over host memory on the virtual CPU mesh — then drains
+the gathered batch into every engine's batched ingress
+(:meth:`IBFT.add_messages`).
+
+This is the high-throughput simulation/benchmark topology promised in
+SURVEY.md §5: consensus rounds become lock-step collective steps, and each
+step moves ALL in-flight messages of the cluster in one fixed-shape
+``(N, M, B)`` uint8 tensor instead of N*M point-to-point sends.
+
+Message slots are length-prefixed (4-byte big-endian) canonical wire
+encodings; empty slots are zero (length 0).  Overflowing an outbox drops
+the oldest messages with a log line — fire-and-forget semantics, matching
+the reference seam (core/transport.go:7-10).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..messages.wire import IbftMessage
+
+_LEN_BYTES = 4
+
+
+class _NodePort:
+    """The per-node Transport seam handed to one IBFT engine."""
+
+    def __init__(self, hub: "IciLockstepTransport", index: int) -> None:
+        self._hub = hub
+        self._index = index
+
+    def multicast(self, message: IbftMessage) -> None:
+        self._hub._enqueue(self._index, message)
+
+
+class IciLockstepTransport:
+    """Hub owning the mesh, the outboxes, and the collective step loop."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        *,
+        devices: Optional[Sequence] = None,
+        max_msgs: int = 16,
+        max_bytes: int = 4096,
+        step_interval: float = 0.002,
+        logger=None,
+    ) -> None:
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < n_nodes:
+            raise ValueError(
+                f"ICI transport needs {n_nodes} devices, have {len(devices)}"
+            )
+        self.mesh = Mesh(np.asarray(devices[:n_nodes]), ("node",))
+        self.n_nodes = n_nodes
+        self.max_msgs = max_msgs
+        self.max_bytes = max_bytes
+        self.step_interval = step_interval
+        self._log = logger
+        self._outboxes: List[List[bytes]] = [[] for _ in range(n_nodes)]
+        self._delivers: List[Callable[[Sequence[IbftMessage]], None]] = []
+        self._task: Optional[asyncio.Task] = None
+        self._sharded = NamedSharding(self.mesh, P("node"))
+        self._replicated = NamedSharding(self.mesh, P())
+        self._gather = jax.jit(
+            lambda x: x, out_shardings=self._replicated
+        )
+
+    # -- wiring ---------------------------------------------------------
+
+    def port(self, index: int) -> _NodePort:
+        return _NodePort(self, index)
+
+    def register(
+        self, deliver_batch: Callable[[Sequence[IbftMessage]], None]
+    ) -> _NodePort:
+        """Register one node's batched ingress; returns its Transport."""
+        index = len(self._delivers)
+        if index >= self.n_nodes:
+            raise ValueError("all node slots taken")
+        self._delivers.append(deliver_batch)
+        return self.port(index)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="ici-lockstep"
+            )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # -- the collective step --------------------------------------------
+
+    def _enqueue(self, index: int, message: IbftMessage) -> None:
+        box = self._outboxes[index]
+        payload = message.encode()
+        if len(payload) + _LEN_BYTES > self.max_bytes:
+            if self._log:
+                self._log.error("ici transport: message exceeds slot size")
+            return
+        box.append(payload)
+
+    def _pack(self) -> Optional[np.ndarray]:
+        if not any(self._outboxes):
+            return None
+        out = np.zeros(
+            (self.n_nodes, self.max_msgs, self.max_bytes), dtype=np.uint8
+        )
+        for n, box in enumerate(self._outboxes):
+            if len(box) > self.max_msgs:
+                if self._log:
+                    self._log.error(
+                        "ici transport: outbox overflow, dropping oldest"
+                    )
+                box = box[-self.max_msgs :]
+            for m, payload in enumerate(box):
+                out[n, m, :_LEN_BYTES] = np.frombuffer(
+                    len(payload).to_bytes(_LEN_BYTES, "big"), np.uint8
+                )
+                out[n, m, _LEN_BYTES : _LEN_BYTES + len(payload)] = (
+                    np.frombuffer(payload, np.uint8)
+                )
+            self._outboxes[n] = []
+        return out
+
+    def step(self) -> None:
+        """One lock-step exchange: pack, all_gather over the mesh, drain."""
+        packed = self._pack()
+        if packed is None:
+            return
+        sharded = jax.device_put(jnp.asarray(packed), self._sharded)
+        gathered = np.asarray(self._gather(sharded))  # (N, M, B) everywhere
+        batch: List[IbftMessage] = []
+        for n in range(self.n_nodes):
+            for m in range(self.max_msgs):
+                ln = int.from_bytes(bytes(gathered[n, m, :_LEN_BYTES]), "big")
+                if ln == 0:
+                    continue
+                try:
+                    batch.append(
+                        IbftMessage.decode(
+                            bytes(gathered[n, m, _LEN_BYTES : _LEN_BYTES + ln])
+                        )
+                    )
+                except Exception as err:  # noqa: BLE001
+                    if self._log:
+                        self._log.error("ici transport: bad slot", err)
+        if not batch:
+            return
+        for deliver in self._delivers:
+            deliver(list(batch))
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.step_interval)
+            self.step()

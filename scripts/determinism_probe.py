@@ -1,0 +1,72 @@
+"""Determinism probe: verification results must be bit-stable across runs.
+
+The reference's reproducible-build CI job builds the binary twice and
+compares hashes (.github/workflows/main.yml:48-67).  The analogue for a
+verification framework is result determinism: two fresh processes running
+the same workload must produce byte-identical masks and quorum sums.
+Printed as canonical JSON; CI `cmp`s two runs.
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from go_ibft_tpu.bench import build_round_workload
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+    from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax.numpy as jnp
+
+    w = build_round_workload(8, corrupt_frac=0.25, seed=11)
+    blocks, counts, r, s, v, senders, live = w.prepare
+    mask, reached, lo, hi = quorum_certify(
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(w.table),
+        jnp.asarray(live),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+    hz, sr, ss_, sv, signers, slive = w.seals
+    smask, sreached, slo, shi = seal_quorum_certify(
+        jnp.asarray(hz),
+        jnp.asarray(sr),
+        jnp.asarray(ss_),
+        jnp.asarray(sv),
+        jnp.asarray(signers),
+        jnp.asarray(w.table),
+        jnp.asarray(slive),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+    json.dump(
+        {
+            "prepare_mask": np.asarray(mask).tolist(),
+            "prepare": [bool(np.asarray(reached)), int(lo), int(hi)],
+            "seal_mask": np.asarray(smask).tolist(),
+            "seal": [bool(np.asarray(sreached)), int(slo), int(shi)],
+        },
+        sys.stdout,
+        sort_keys=True,
+    )
+    sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    main()

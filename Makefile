@@ -1,0 +1,34 @@
+# Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
+# protoc targets).  Translated to this build's toolchain.
+.PHONY: test test-fast test-slow test-device lint native bench dryrun clean
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	python -m pytest tests/ -q -m slow
+
+# Device suites on real hardware (opt-in, see tests/conftest.py)
+test-device:
+	GO_IBFT_TPU_TESTS=1 python -m pytest tests/ -q
+
+lint:
+	ruff check go_ibft_tpu/ tests/ bench.py __graft_entry__.py
+	python -m compileall -q go_ibft_tpu/ tests/ bench.py
+
+# Build the native C++ runtime baseline (also auto-built on first import)
+native:
+	python -c "from go_ibft_tpu import native; assert native.load() is not None, native.build_error()"
+
+bench:
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py
+
+clean:
+	rm -rf go_ibft_tpu/native/_build
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -162,7 +162,7 @@ def config1_happy_path() -> None:
     host_ms = run_cluster(HostBatchVerifier)
     _log(
         {
-            "metric": "happy_path_4v_height_latency",
+            "metric": config1_happy_path.metric,
             "value": round(device_ms, 2),
             "unit": "ms",
             "vs_baseline": round(host_ms / device_ms, 2),
@@ -200,7 +200,7 @@ def config3_pipelined() -> None:
     verifies = 1000 * 2 * heights
     _log(
         {
-            "metric": "ecdsa_1000v_10h_pipelined_throughput",
+            "metric": config3_pipelined.metric,
             "value": round(verifies / elapsed, 1),
             "unit": "sig-verifies/sec/chip",
             "vs_baseline": None,
@@ -217,7 +217,7 @@ def config4_bls() -> None:
     except ImportError:
         _log(
             {
-                "metric": "bls_aggregate_verify_p50_100v",
+                "metric": config4_bls.metric,
                 "value": None,
                 "unit": "ms",
                 "vs_baseline": None,
@@ -235,7 +235,7 @@ def config4_bls() -> None:
         times.append((time.perf_counter() - t0) * 1e3)
     _log(
         {
-            "metric": "bls_aggregate_verify_p50_100v",
+            "metric": config4_bls.metric,
             "value": round(statistics.median(times), 3),
             "unit": "ms",
             "vs_baseline": round(w.host_ms / statistics.median(times), 2)
@@ -269,7 +269,7 @@ def config5_byzantine_mix() -> None:
         times.append((time.perf_counter() - t0) * 1e3)
     _log(
         {
-            "metric": "byzantine_300v_30pct_prepare_commit_p50",
+            "metric": config5_byzantine_mix.metric,
             "value": round(statistics.median(times), 3),
             "unit": "ms",
             "vs_baseline": None,
@@ -365,16 +365,50 @@ def config2_headline() -> None:
     )
 
 
+def _guarded(config_fn, failures: list) -> None:
+    """Secondary configs must not take down the headline mid-run: report
+    the failure as a JSON line, keep going, and fail the process AFTER the
+    headline printed (main()).  The differential smoke and the headline
+    stay immediately fatal — a wrong kernel must never 'benchmark'."""
+    try:
+        config_fn()
+    except Exception as err:  # noqa: BLE001
+        failures.append(config_fn.metric)
+        _log(
+            {
+                "metric": config_fn.metric,
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "error": f"{type(err).__name__}: {err}"[:300],
+            }
+        )
+
+
+config1_happy_path.metric = "happy_path_4v_height_latency"
+config3_pipelined.metric = "ecdsa_1000v_10h_pipelined_throughput"
+config4_bls.metric = "bls_aggregate_verify_p50_100v"
+config5_byzantine_mix.metric = "byzantine_300v_30pct_prepare_commit_p50"
+
+
 def main() -> None:
+    import sys
+
     from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
 
     enable_persistent_cache()
     differential_smoke()
-    config1_happy_path()
-    config3_pipelined()
-    config4_bls()
-    config5_byzantine_mix()
+    failures: list = []
+    for config_fn in (
+        config1_happy_path,
+        config3_pipelined,
+        config4_bls,
+        config5_byzantine_mix,
+    ):
+        _guarded(config_fn, failures)
     config2_headline()  # headline LAST: drivers read the final JSON line
+    if failures:  # correctness gates tripped above: exit nonzero for CI
+        sys.exit(f"bench configs failed: {', '.join(failures)}")
 
 
 if __name__ == "__main__":

@@ -125,6 +125,10 @@ def f6_mul_v(a: F6) -> F6:
 
 
 def f6_inv(a: F6) -> F6:
+    # Callers hand in lazily-accumulated operands (f12_inv's f6_sub of
+    # f6_mul outputs); renorm first so the squarings below stay inside the
+    # REDC input range.
+    a = f6_renorm(a)
     c0 = fp.f2_sub(fp.f2_sqr(a.c0), fp.f2_mul_xi(fp.f2_mul(a.c1, a.c2)))
     c1 = fp.f2_sub(fp.f2_mul_xi(fp.f2_sqr(a.c2)), fp.f2_mul(a.c0, a.c1))
     c2 = fp.f2_sub(fp.f2_sqr(a.c1), fp.f2_mul(a.c0, a.c2))
@@ -305,13 +309,16 @@ def _jac_ops(F):
         b = F.sqr(p.y)
         c = F.sqr(b)
         t = F.sqr(F.add(p.x, b))
-        d = F.muli(F.sub(F.sub(t, a), c), 2)
+        # d and x3 are renormed before feeding the y3 product: the lazy
+        # sub-chains fatten their bounds past the REDC input range otherwise
+        # (the bound algebra is asserted at trace time in bls_fp._redc).
+        d = F.renorm(F.muli(F.sub(F.sub(t, a), c), 2))
         e = F.muli(a, 3)
         ff = F.sqr(e)
-        x3 = F.sub(ff, F.muli(d, 2))
+        x3 = F.renorm(F.sub(ff, F.muli(d, 2)))
         y3 = F.sub(F.mul(e, F.sub(d, x3)), F.muli(c, 8))
         z3 = F.muli(F.mul(p.y, p.z), 2)
-        return type(p)(F.renorm(x3), F.renorm(y3), F.renorm(z3))
+        return type(p)(x3, F.renorm(y3), F.renorm(z3))
 
     def add_complete(p, q):
         z1s = F.sqr(p.z)
@@ -320,8 +327,9 @@ def _jac_ops(F):
         u2 = F.mul(q.x, z1s)
         s1 = F.mul(p.y, F.mul(z2s, q.z))
         s2 = F.mul(q.y, F.mul(z1s, p.z))
-        h = F.sub(u2, u1)
-        r = F.sub(s2, s1)
+        # Renormed: h and r feed long mul chains below (bound hygiene).
+        h = F.renorm(F.sub(u2, u1))
+        r = F.renorm(F.sub(s2, s1))
         hs = F.sqr(h)
         hc = F.mul(hs, h)
         u1hs = F.mul(u1, hs)

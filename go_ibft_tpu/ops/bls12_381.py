@@ -479,10 +479,16 @@ def _dbl_step(T: G2Jac, xP: FV, yP: FV):
 
 def _add_step(T: G2Jac, qx: F2, qy: F2, xP: FV, yP: FV):
     """Line through T and the affine twist point Q, evaluated at P; plus
-    T + Q (mixed).  Scaled by -(Z * lambda):
-    e0 = -yP xi Z H  ->  scaled: yP xi Z H ... final scaling chosen so
-    e0 = -(yP xi) Z H is consistent with e3 = r xQ - yQ Z H, e5 = xP r
-    where H = xQ Z^2 - X, r = yQ Z^3 - Y.
+    T + Q (mixed).
+
+    With H = xQ Z^2 - X, r = yQ Z^3 - Y, the chord line scaled by Z*H and
+    mapped into the w-basis via the same ``* w^3`` trick as the doubling
+    line (w^6 = xi), overall sign -1 (legal: -1 is in Fp, killed by the
+    final exponentiation):
+    e0 = -xi Z H yP,  e3 = yQ Z H - r xQ,  e5 = xP r.
+    (The e3 sign is load-bearing: r xQ - yQ Z H breaks the Fp2-ratio
+    invariant against the untwisted chord and fails the pairing; see
+    tests/test_bls_device.py::test_pairing_matches_host_cubed.)
     """
     X, Y, Z = T.x, T.y, T.z
     z2 = fp.f2_sqr(Z)
@@ -491,7 +497,7 @@ def _add_step(T: G2Jac, qx: F2, qy: F2, xP: FV, yP: FV):
     r = fp.f2_sub(fp.f2_mul(qy, z3), Y)
     zh = fp.f2_mul(Z, H)
     e0 = fp.f2_neg(fp.f2_mul_xi(_f2_mul_fp(zh, yP)))
-    e3 = fp.f2_sub(fp.f2_mul(r, qx), fp.f2_mul(qy, zh))
+    e3 = fp.f2_sub(fp.f2_mul(qy, zh), fp.f2_mul(r, qx))
     e5 = _f2_mul_fp(r, xP)
 
     hs = fp.f2_sqr(H)

@@ -15,6 +15,9 @@ from go_ibft_tpu.messages import Proposal, View
 from go_ibft_tpu.messages.helpers import CommittedSeal
 from go_ibft_tpu.verify import DeviceBatchVerifier, HostBatchVerifier
 
+# Cold EC-ladder kernel compiles take minutes; slow tier only.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def cluster_keys():

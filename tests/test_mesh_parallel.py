@@ -15,6 +15,10 @@ from go_ibft_tpu.bench import build_round_workload
 from go_ibft_tpu.ops.quorum import quorum_certify
 from go_ibft_tpu.parallel import make_mesh, mesh_quorum_certify
 
+# The shard_map mesh program is one of the largest compiles in the tree
+# (tens of minutes cold on a CI runner); keep it out of the fast tier.
+pytestmark = pytest.mark.slow
+
 
 def _args(w):
     blocks, counts, r, s, v, senders, live = w.prepare

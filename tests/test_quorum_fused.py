@@ -16,6 +16,9 @@ from go_ibft_tpu.ops.quorum import (
     split_power,
 )
 
+# Cold EC-ladder kernel compiles take minutes; slow tier only.
+pytestmark = pytest.mark.slow
+
 
 def _prep_args(w):
     blocks, counts, r, s, v, senders, live = w.prepare

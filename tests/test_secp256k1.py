@@ -16,6 +16,9 @@ from go_ibft_tpu.crypto import keccak256
 from go_ibft_tpu.ops import fields
 from go_ibft_tpu.ops import secp256k1 as sec
 
+# Cold EC-ladder kernel compiles take minutes; slow tier only.
+pytestmark = pytest.mark.slow
+
 L = sec.FIELD.nlimbs
 
 

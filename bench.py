@@ -21,7 +21,10 @@ never silently "benchmark".
 """
 
 import json
+import os
 import statistics
+import subprocess
+import sys
 import time
 
 import jax
@@ -30,9 +33,67 @@ import numpy as np
 
 REPS = 30
 
+# Set by main() when the default backend was dead and the run fell back to
+# CPU: secondary configs with 512/1024-lane compiles are skipped (a 1-core
+# CPU fallback must still finish inside the driver's budget) and rep counts
+# shrink.  The headline config always runs.
+_FALLBACK = False
+
+
+def _reps() -> int:
+    return 3 if _FALLBACK else REPS
+
+# Probe budget for the default (TPU) backend before falling back to CPU.
+# The tunneled axon backend has been observed to HANG on init (not fail
+# fast), so the probe runs in a subprocess with a hard timeout.
+_PROBE_TIMEOUT_S = int(os.environ.get("GO_IBFT_BENCH_PROBE_TIMEOUT", "240"))
+_PROBE_ATTEMPTS = int(os.environ.get("GO_IBFT_BENCH_PROBE_ATTEMPTS", "2"))
+
 
 def _log(obj) -> None:
     print(json.dumps(obj), flush=True)
+
+
+def ensure_live_backend() -> str:
+    """Probe the default JAX backend in a subprocess; pin CPU if it's dead.
+
+    Rounds 1-2 produced NO benchmark number because the tunneled TPU
+    backend failed/hung at init time and the process exited 1 before any
+    config ran.  A degraded-but-labeled CPU number beats no number: every
+    JSON line carries the platform it ran on, so a fallback can never be
+    mistaken for a TPU result.  Must run before anything initializes the
+    backend in THIS process (backend choice is sticky once initialized).
+    """
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready();"
+        "print('PLATFORM=' + d[0].platform)"
+    )
+    for attempt in range(_PROBE_ATTEMPTS):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=_PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            _log({"metric": "backend_probe", "attempt": attempt, "error": "timeout"})
+            continue
+        for line in out.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1]
+        _log(
+            {
+                "metric": "backend_probe",
+                "attempt": attempt,
+                "error": (out.stderr.strip().splitlines() or ["no output"])[-1][:200],
+            }
+        )
+        time.sleep(5)
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu (fallback: default backend unavailable)"
 
 
 def _prep_args(w):
@@ -229,7 +290,7 @@ def config4_bls() -> None:
     ok = aggregate_verify_commit(*w.args)
     assert bool(np.asarray(ok)), "BLS aggregate verify failed correctness gate"
     times = []
-    for _ in range(REPS):
+    for _ in range(_reps()):
         t0 = time.perf_counter()
         jax.block_until_ready(aggregate_verify_commit(*w.args))
         times.append((time.perf_counter() - t0) * 1e3)
@@ -262,7 +323,7 @@ def config5_byzantine_mix() -> None:
     assert bool(np.asarray(reached)) and bool(np.asarray(sreached))
 
     times = []
-    for _ in range(REPS):
+    for _ in range(_reps()):
         t0 = time.perf_counter()
         out = (quorum_certify(*pa), seal_quorum_certify(*sa))
         jax.block_until_ready(out)
@@ -294,7 +355,7 @@ def config2_headline() -> None:
     assert np.asarray(smask)[:n].all() and bool(np.asarray(sreached))
 
     times = []
-    for _ in range(REPS):
+    for _ in range(_reps()):
         t0 = time.perf_counter()
         m1 = quorum_certify(*pa)
         m2 = seal_quorum_certify(*sa)
@@ -352,17 +413,21 @@ def config2_headline() -> None:
         baseline_name = "pure-Python sequential per-message verify"
         assert hm1.all() and hm2.all()
 
-    _log(
-        {
-            "metric": "prepare_commit_quorum_verify_p50_100v",
-            "value": round(p50, 3),
-            "unit": "ms",
-            "vs_baseline": round(host_ms / p50, 2),
-            "baseline": baseline_name,
-            "baseline_ms": round(host_ms, 1),
-            "device": jax.devices()[0].platform,
-        }
-    )
+    line = {
+        "metric": "prepare_commit_quorum_verify_p50_100v",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(host_ms / p50, 2),
+        "baseline": baseline_name,
+        "baseline_ms": round(host_ms, 1),
+        "device": jax.devices()[0].platform,
+    }
+    if _FALLBACK:
+        line["note"] = (
+            "TPU backend unavailable; CPU fallback is NOT the target "
+            "platform for the <2ms/>=30x goal (BASELINE.md config #2)"
+        )
+    _log(line)
 
 
 def _guarded(config_fn, failures: list) -> None:
@@ -392,20 +457,34 @@ config5_byzantine_mix.metric = "byzantine_300v_30pct_prepare_commit_p50"
 
 
 def main() -> None:
-    import sys
+    global _FALLBACK
 
     from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
 
+    platform = ensure_live_backend()
+    _FALLBACK = platform.startswith("cpu (fallback")
     enable_persistent_cache()
+    _log({"metric": "bench_platform", "value": platform})
     differential_smoke()
     failures: list = []
-    for config_fn in (
-        config1_happy_path,
-        config3_pipelined,
-        config4_bls,
-        config5_byzantine_mix,
-    ):
+    configs = (
+        (config1_happy_path,)
+        if _FALLBACK  # skip the pairing + 512/1024-lane cold compiles on 1-core CPU
+        else (config1_happy_path, config3_pipelined, config4_bls, config5_byzantine_mix)
+    )
+    for config_fn in configs:
         _guarded(config_fn, failures)
+    if _FALLBACK:
+        for skipped in (config3_pipelined, config4_bls, config5_byzantine_mix):
+            _log(
+                {
+                    "metric": skipped.metric,
+                    "value": None,
+                    "unit": None,
+                    "vs_baseline": None,
+                    "note": "skipped on CPU fallback (TPU backend unavailable)",
+                }
+            )
     config2_headline()  # headline LAST: drivers read the final JSON line
     if failures:  # correctness gates tripped above: exit nonzero for CI
         sys.exit(f"bench configs failed: {', '.join(failures)}")

@@ -135,8 +135,35 @@ _RC_WORDS = np.asarray(
 )
 
 
+def _pallas_mode() -> str:
+    """'' (off) | 'compiled' | 'interpret' — the Pallas permutation switch.
+
+    ``GO_IBFT_PALLAS=1`` selects the Pallas kernel on TPU backends (no-op
+    elsewhere: the compiled kernel needs Mosaic); ``GO_IBFT_PALLAS=interpret``
+    forces the interpreter on any backend (tests/debugging).
+    """
+    import os
+
+    flag = os.environ.get("GO_IBFT_PALLAS", "")
+    if flag == "interpret":
+        return "interpret"
+    if flag == "1" and jax.default_backend() == "tpu":
+        return "compiled"
+    return ""
+
+
 def keccak_f(state: jnp.ndarray) -> jnp.ndarray:
-    """Keccak-f[1600] on a ``(..., 25, 2)`` uint32 state (scan over rounds)."""
+    """Keccak-f[1600] on a ``(..., 25, 2)`` uint32 state (scan over rounds).
+
+    With ``GO_IBFT_PALLAS`` set (see :func:`_pallas_mode`), 1-D batches
+    route to the Pallas kernel's register-native layout instead
+    (:mod:`.pallas_keccak`).
+    """
+    mode = _pallas_mode()
+    if mode and state.ndim == 3 and state.shape[-2:] == (25, 2):
+        from .pallas_keccak import keccak_f_pallas  # lazy: avoids a cycle
+
+        return keccak_f_pallas(state, interpret=mode == "interpret")
 
     def body(st, rc):
         return _keccak_round(st, rc), None

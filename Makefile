@@ -1,6 +1,7 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
-.PHONY: test test-fast test-slow test-device lint native bench dryrun clean
+.PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
+	warm cluster-bench
 
 test:
 	python -m pytest tests/ -q
@@ -16,8 +17,8 @@ test-device:
 	GO_IBFT_TPU_TESTS=1 python -m pytest tests/ -q
 
 lint:
-	ruff check go_ibft_tpu/ tests/ bench.py __graft_entry__.py
-	python -m compileall -q go_ibft_tpu/ tests/ bench.py
+	ruff check go_ibft_tpu/ tests/ scripts/ examples/ bench.py __graft_entry__.py
+	python -m compileall -q go_ibft_tpu/ tests/ scripts/ examples/ bench.py
 
 # Build the native C++ runtime baseline (also auto-built on first import)
 native:
@@ -25,6 +26,16 @@ native:
 
 bench:
 	python bench.py
+
+# Pre-warm the expensive kernel compiles into the persistent XLA cache
+# (CI slow tier runs this before pytest so no compile hits a test timeout)
+warm:
+	python scripts/warm_kernels.py
+
+# Engine-level throughput: N-node cluster finalizing H heights
+cluster-bench:
+	python scripts/cluster_bench.py --nodes 4 --heights 5
+	python scripts/cluster_bench.py --nodes 4 --heights 5 --transport ici
 
 dryrun:
 	python __graft_entry__.py

@@ -1,0 +1,155 @@
+"""End-to-end cluster throughput: N validators finalizing H heights.
+
+The engine-level complement to bench.py's kernel-level configs: spins up a
+full in-process cluster (every node runs the real asyncio state machine)
+and measures heights/sec over either transport backend:
+
+* ``loopback``   — direct in-process multicast (the reference's test
+                   topology, go-ibft core/helpers_test.go:227-231);
+* ``ici``        — the lock-step collective transport: one validator per
+                   mesh device, multicast = one fixed-shape all_gather per
+                   step (needs >= N devices; on CPU set
+                   ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+
+Usage: ``python scripts/cluster_bench.py [--nodes 4] [--heights 5]
+[--transport loopback|ici] [--crypto]``
+
+``--crypto`` switches the mock backend for real ECDSA signing/verification
+(host path; attach a device verifier through bench.py's configs instead
+when measuring kernels — this script measures the *consensus runtime*).
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+
+def _build_engines(n: int, crypto: bool):
+    from go_ibft_tpu.core import IBFT
+
+    if crypto:
+        from go_ibft_tpu.crypto import PrivateKey
+        from go_ibft_tpu.crypto.backend import ECDSABackend
+
+        keys = [PrivateKey.from_seed(b"cluster-bench-%d" % i) for i in range(n)]
+        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        backends = [ECDSABackend(k, src) for k in keys]
+    else:
+        from harness import MockBackend
+
+        class _Shim:
+            def __init__(self, addresses):
+                self.addresses = list(addresses)
+
+                class _N:
+                    def __init__(self, a):
+                        self.address = a
+
+                self.nodes = [_N(a) for a in self.addresses]
+
+            def proposer_for(self, height, round_):
+                return self.addresses[(height + round_) % len(self.addresses)]
+
+        shim = _Shim([b"node-%02d-pad-pad-pad" % i for i in range(n)])
+        backends = [MockBackend(a, shim) for a in shim.addresses]
+
+    class _Null:
+        def info(self, *a):
+            pass
+
+        debug = error = info
+
+    engines = []
+    for b in backends:
+        e = IBFT(_Null(), b, None)
+        e.set_base_round_timeout(10.0)
+        engines.append(e)
+    return engines
+
+
+async def _run(engines, heights: int, transport: str) -> float:
+    from go_ibft_tpu.core.transport import LoopbackTransport
+
+    hub = None
+    if transport == "ici":
+        from go_ibft_tpu.net import IciLockstepTransport
+
+        hub = IciLockstepTransport(len(engines), step_interval=0.001)
+        for e in engines:
+            e.transport = hub.register(e.add_messages)
+        hub.start()
+    else:
+        loop = LoopbackTransport()
+        for e in engines:
+            loop.register(e.add_message)
+            e.transport = loop
+
+    t0 = time.perf_counter()
+    try:
+        for h in range(1, heights + 1):
+            await asyncio.wait_for(
+                asyncio.gather(*(e.run_sequence(h) for e in engines)), 120
+            )
+    finally:
+        if hub is not None:
+            await hub.stop()
+        for e in engines:
+            e.messages.close()
+    elapsed = time.perf_counter() - t0
+    for e in engines:
+        assert len(e.backend.inserted) == heights, "a node missed a height"
+    return elapsed
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--heights", type=int, default=5)
+    ap.add_argument("--transport", choices=("loopback", "ici"), default="loopback")
+    ap.add_argument("--crypto", action="store_true")
+    ap.add_argument(
+        "--platform",
+        default=None,
+        help="pin the jax platform (e.g. cpu); for --transport ici on CPU "
+        "this also forces nodes-many virtual devices.  Env vars are not "
+        "authoritative in containers with a sitecustomize hook — only "
+        "jax.config.update before backend init works.",
+    )
+    args = ap.parse_args()
+
+    if args.platform or args.transport == "ici":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", args.platform or "cpu")
+            if (args.platform or "cpu") == "cpu":
+                jax.config.update("jax_num_cpu_devices", args.nodes)
+        except RuntimeError:
+            pass  # backend already initialized; keep whatever is live
+
+    engines = _build_engines(args.nodes, args.crypto)
+    elapsed = asyncio.run(_run(engines, args.heights, args.transport))
+    print(
+        json.dumps(
+            {
+                "metric": "cluster_heights_per_sec",
+                "value": round(args.heights / elapsed, 2),
+                "unit": "heights/sec",
+                "vs_baseline": None,
+                "nodes": args.nodes,
+                "heights": args.heights,
+                "transport": args.transport,
+                "crypto": bool(args.crypto),
+                "elapsed_s": round(elapsed, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

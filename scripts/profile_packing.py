@@ -1,0 +1,78 @@
+"""Profile the host-side packing edge (messages -> device-ready arrays).
+
+The fused kernels are only as fast as the host edge that feeds them: if
+packing a 1000-message round costs more than the kernel, the end-to-end
+p50 is host-bound.  This script times each packing stage separately so
+optimization effort lands where the time actually goes.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402 - sys.path setup must precede package imports
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+    from go_ibft_tpu.messages.helpers import extract_committed_seal
+    from go_ibft_tpu.messages.wire import Proposal, View
+    from go_ibft_tpu.verify.batch import (
+        pack_seal_batch,
+        pack_sender_batch,
+        pack_validator_table,
+    )
+
+    keys = _keys(n, 0)
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=1, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"profile block", round=0))
+
+    t0 = time.perf_counter()
+    prepares = [b.build_prepare_message(phash, view) for b in backends]
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    t_build = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    payloads = [m.encode(include_signature=False) for m in prepares]
+    t_encode = time.perf_counter() - t0
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pack_sender_batch(prepares)
+    t_sender = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pack_seal_batch(phash, seals)
+    t_seal = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pack_validator_table([k.address for k in keys])
+    t_table = (time.perf_counter() - t0) / reps
+
+    print(f"n={n}")
+    print(f"  build+sign (one-time)     : {t_build * 1e3:9.2f} ms")
+    print(f"  wire encode (per pack)    : {t_encode * 1e3:9.2f} ms [{len(payloads[0])}B each]")
+    print(f"  pack_sender_batch         : {t_sender * 1e3:9.2f} ms")
+    print(f"  pack_seal_batch           : {t_seal * 1e3:9.2f} ms")
+    print(f"  pack_validator_table      : {t_table * 1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Metrics registry: gauges (reference core/ibft.go:138-141), histograms,
+sink fan-out, bounded windows, and engine wiring of the duration gauges."""
+
+import asyncio
+
+from go_ibft_tpu.utils import metrics
+
+from harness import Cluster
+
+
+def setup_function(_fn):
+    metrics.reset()
+
+
+def test_gauge_set_get():
+    metrics.set_gauge(("go-ibft", "sequence", "duration"), 1.25)
+    assert metrics.get_gauge(("go-ibft", "sequence", "duration")) == 1.25
+    assert metrics.get_gauge(("missing",)) is None
+
+
+def test_histogram_window_bounded():
+    key = ("verify", "latency")
+    for i in range(5000):
+        metrics.observe(key, float(i))
+    got = metrics.get_histogram(key)
+    assert len(got) == 4096  # bounded: a forever-running validator can't leak
+    assert got[-1] == 4999.0 and got[0] == 5000 - 4096
+
+
+def test_sink_receives_samples():
+    seen = []
+    metrics.set_sink(lambda kind, key, value: seen.append((kind, key, value)))
+    try:
+        metrics.set_gauge(("a",), 1.0)
+        metrics.observe(("b",), 2.0)
+    finally:
+        metrics.set_sink(None)
+    assert ("gauge", ("a",), 1.0) in seen
+    assert ("histogram", ("b",), 2.0) in seen
+
+
+def test_reset_clears_everything():
+    metrics.set_gauge(("a",), 1.0)
+    metrics.observe(("b",), 2.0)
+    metrics.reset()
+    assert metrics.get_gauge(("a",)) is None
+    assert metrics.get_histogram(("b",)) == []
+
+
+async def test_engine_records_duration_gauges():
+    """One finalized height must set both reference gauges
+    (go-ibft.sequence.duration / go-ibft.round.duration)."""
+    cluster = Cluster(4)
+    try:
+        await asyncio.wait_for(cluster.progress_to_height(1), 10)
+    finally:
+        cluster.shutdown()
+    assert metrics.get_gauge(("go-ibft", "sequence", "duration")) is not None
+    assert metrics.get_gauge(("go-ibft", "round", "duration")) is not None

@@ -43,6 +43,27 @@ from .keccak import keccak256
 
 SIG_BYTES = 65
 
+_native_tried = False
+
+
+def _try_native_fast_paths() -> None:
+    """Best-effort one-time registration of the C++ fast paths.
+
+    Signing each outbound message costs ~90ms of pure Python (one nonce
+    scalar-mult) — material against a 2ms round budget; the native path is
+    bit-identical (tests/test_native.py) and degrades gracefully when no
+    compiler exists."""
+    global _native_tried
+    if _native_tried:
+        return
+    _native_tried = True
+    try:
+        from .. import native
+
+        native.install()
+    except Exception:  # noqa: BLE001 - missing toolchain keeps pure Python
+        pass
+
 
 def encode_signature(r: int, s: int, v: int) -> bytes:
     return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
@@ -70,6 +91,7 @@ class ECDSABackend:
         validators_for_height: Callable[[int], Mapping[bytes, int]],
         build_proposal_fn: Optional[Callable[[View], bytes]] = None,
     ):
+        _try_native_fast_paths()
         self.key = key
         self.address = key.address
         self._validators = validators_for_height

@@ -21,7 +21,8 @@ swap in your HSM for production).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from functools import cached_property
+from typing import Callable, Optional, Tuple
 
 from .keccak import keccak256
 
@@ -86,15 +87,47 @@ class PrivateKey:
         d = int.from_bytes(keccak256(seed), "big") % N
         return cls(d or 1)
 
-    @property
+    # cached: a fresh 256-step double-and-add per access made every
+    # `key.address` touch cost ~60ms of pure Python (measured via
+    # scripts/profile_packing.py); cached_property writes straight into
+    # __dict__, which a frozen dataclass permits.
+    @cached_property
     def pubkey(self) -> Tuple[int, int]:
+        if _native_pubkey is not None:
+            out = _native_pubkey(self.d.to_bytes(32, "big"))
+            if out is not None:
+                return (
+                    int.from_bytes(out[:32], "big"),
+                    int.from_bytes(out[32:], "big"),
+                )
         pt = scalar_mul(self.d, (GX, GY))
         assert pt is not None
         return pt
 
-    @property
+    @cached_property
     def address(self) -> bytes:
         return pubkey_to_address(*self.pubkey)
+
+
+# Native (C++) fast paths, registered by go_ibft_tpu.native.install().
+# Bit-identical to the Python implementations (differential-tested in
+# tests/test_native.py); None falls through to pure Python.
+_native_sign: Optional[Callable[[bytes, bytes], Optional[Tuple[int, int, int]]]] = None
+_native_pubkey: Optional[Callable[[bytes], Optional[bytes]]] = None
+
+
+def set_native_sign(
+    fn: Optional[Callable[[bytes, bytes], Optional[Tuple[int, int, int]]]]
+) -> None:
+    """Register a native deterministic sign; ``None`` restores pure Python."""
+    global _native_sign
+    _native_sign = fn
+
+
+def set_native_pubkey(fn: Optional[Callable[[bytes], Optional[bytes]]]) -> None:
+    """Register a native pubkey derivation; ``None`` restores pure Python."""
+    global _native_pubkey
+    _native_pubkey = fn
 
 
 def sign(key: PrivateKey, digest: bytes) -> Tuple[int, int, int]:
@@ -103,6 +136,10 @@ def sign(key: PrivateKey, digest: bytes) -> Tuple[int, int, int]:
     ``v`` is the recovery id (y-parity of the nonce point, flipped when s is
     negated), so ``recover(digest, r, s, v)`` round-trips to the pubkey.
     """
+    if _native_sign is not None:
+        out = _native_sign(key.d.to_bytes(32, "big"), digest)
+        if out is not None:
+            return out
     z = digest_to_scalar(digest)
     counter = 0
     while True:

@@ -70,6 +70,10 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
         ]
         lib.ibft_ecdsa_recover.restype = ctypes.c_int
+        lib.ibft_ecdsa_sign.argtypes = [ctypes.c_char_p] * 3
+        lib.ibft_ecdsa_sign.restype = ctypes.c_int
+        lib.ibft_ecdsa_pubkey.argtypes = [ctypes.c_char_p] * 2
+        lib.ibft_ecdsa_pubkey.restype = ctypes.c_int
         lib.ibft_verify_batch_sequential.argtypes = [
             ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.c_char_p, ctypes.c_void_p,
@@ -109,6 +113,34 @@ def ecdsa_recover(digest: bytes, rs: bytes, v: int) -> Optional[bytes]:
     return out.raw
 
 
+def ecdsa_sign(d_be: bytes, digest: bytes) -> Optional[Tuple[int, int, int]]:
+    """Deterministic sign: 32-byte BE private scalar + 32-byte digest ->
+    ``(r, s, v)``; None for an out-of-range key."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    out = ctypes.create_string_buffer(65)
+    if not lib.ibft_ecdsa_sign(d_be, digest, out):
+        return None
+    sig = out.raw
+    return (
+        int.from_bytes(sig[:32], "big"),
+        int.from_bytes(sig[32:64], "big"),
+        sig[64],
+    )
+
+
+def ecdsa_pubkey(d_be: bytes) -> Optional[bytes]:
+    """32-byte BE private scalar -> 64-byte BE ``X || Y``; None if invalid."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    out = ctypes.create_string_buffer(64)
+    if not lib.ibft_ecdsa_pubkey(d_be, out):
+        return None
+    return out.raw
+
+
 def verify_batch_sequential(
     digests: Sequence[bytes],
     sigs: Sequence[bytes],
@@ -134,13 +166,17 @@ def verify_batch_sequential(
 
 
 def install() -> bool:
-    """Register the native keccak as the crypto-layer fast path.
+    """Register the native fast paths (keccak, sign, pubkey derivation).
 
-    Returns True when the native library is active."""
+    All are bit-identical to the pure-Python implementations
+    (differential-tested); returns True when the native library is active."""
     lib = load()
     if lib is None:
         return False
+    from ..crypto import ecdsa as ecdsa_mod
     from ..crypto import keccak as keccak_mod
 
     keccak_mod.set_native_impl(keccak256)
+    ecdsa_mod.set_native_sign(ecdsa_sign)
+    ecdsa_mod.set_native_pubkey(ecdsa_pubkey)
     return True

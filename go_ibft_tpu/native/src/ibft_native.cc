@@ -534,6 +534,57 @@ void digest_to_scalar(const uint8_t digest[32], U256* out) {
   if (cmp(*out, kN) >= 0) sub_u(*out, kN, out);
 }
 
+// Deterministic ECDSA sign, bit-identical to the Python reference path
+// (crypto/ecdsa.py::sign): nonce k = keccak256(d || digest || counter) mod n,
+// low-s normalization with the recovery id flipped alongside s.
+bool ecdsa_sign_impl(const U256& d, const uint8_t digest[32], U256* r_out,
+                     U256* s_out, int* v_out) {
+  U256 z;
+  digest_to_scalar(digest, &z);
+  uint8_t buf[65];
+  store_be(d, buf);
+  std::memcpy(buf + 32, digest, 32);
+  U256 half = kN;  // n >> 1 == n // 2 (n is odd)
+  for (int i = 0; i < 4; ++i) {
+    half.w[i] >>= 1;
+    if (i < 3) half.w[i] |= kN.w[i + 1] << 63;
+  }
+  // The Python loop is unbounded; 256 nonce retries is unreachable in
+  // practice (each retry needs k==0, r==0, or s==0).
+  for (int counter = 0; counter < 256; ++counter) {
+    buf[64] = (uint8_t)counter;
+    uint8_t kd[32];
+    keccak256(buf, 65, kd);
+    U256 k;
+    load_be(kd, &k);
+    if (cmp(k, kN) >= 0) sub_u(k, kN, &k);  // k_raw < 2^256 < 2n
+    if (is_zero(k)) continue;
+    Jac g = {kGx, kGy, {{1, 0, 0, 0}}}, pt;
+    ecmul2(k, kZero, g, &pt);
+    U256 x, y;
+    to_affine(pt, &x, &y);
+    U256 r = x;
+    if (cmp(r, kN) >= 0) sub_u(r, kN, &r);  // x < p < 2n
+    if (is_zero(r)) continue;
+    U256 kinv, rd, t, s;
+    invmod(k, MOD_N, &kinv);
+    mulmod(r, d, MOD_N, &rd);
+    addmod(z, rd, kN, &t);
+    mulmod(kinv, t, MOD_N, &s);
+    if (is_zero(s)) continue;
+    int v = (int)(y.w[0] & 1);
+    if (cmp(s, half) > 0) {
+      submod(kN, s, kN, &s);
+      v ^= 1;
+    }
+    *r_out = r;
+    *s_out = s;
+    *v_out = v;
+    return true;
+  }
+  return false;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -596,6 +647,37 @@ void ibft_verify_batch_sequential(size_t n, const uint8_t* digests,
       member = std::memcmp(addr, table + 20 * j, 20) == 0;
     out[i] = member ? 1 : 0;
   }
+}
+
+// Deterministic sign: d (32B BE) + digest (32B) -> sig r||s||v (65B).
+// Returns 1 on success, 0 for an out-of-range private key.
+int ibft_ecdsa_sign(const uint8_t* d, const uint8_t* digest,
+                    uint8_t* sig_out) {
+  U256 dd;
+  load_be(d, &dd);
+  if (!in_scalar_range(dd)) return 0;
+  U256 r, s;
+  int v;
+  if (!ecdsa_sign_impl(dd, digest, &r, &s, &v)) return 0;
+  store_be(r, sig_out);
+  store_be(s, sig_out + 32);
+  sig_out[64] = (uint8_t)v;
+  return 1;
+}
+
+// Public-key derivation: d (32B BE) -> x||y (64B BE). Returns 1 on success.
+int ibft_ecdsa_pubkey(const uint8_t* d, uint8_t* pub_out) {
+  U256 dd;
+  load_be(d, &dd);
+  if (!in_scalar_range(dd)) return 0;
+  Jac g = {kGx, kGy, {{1, 0, 0, 0}}}, pt;
+  ecmul2(dd, kZero, g, &pt);
+  if (jac_inf(pt)) return 0;
+  U256 x, y;
+  to_affine(pt, &x, &y);
+  store_be(x, pub_out);
+  store_be(y, pub_out + 32);
+  return 1;
 }
 
 }  // extern "C"

@@ -73,11 +73,47 @@ def test_native_sequential_batch_masks():
     assert list(mask) == [True, True, False, True, False, True, True, False]
 
 
+def test_native_sign_bit_identical_to_python():
+    """Deterministic nonce + low-s + recovery id must match Python exactly
+    (the engine's multicast path signs with whichever is registered — any
+    divergence would split the cluster's accept-sets)."""
+    host.set_native_sign(None)  # ensure the Python reference path
+    rng = np.random.default_rng(23)
+    for i in range(8):
+        k = PrivateKey.from_seed(bytes(rng.bytes(16)))
+        digest = keccak256(rng.bytes(40 + i))
+        want = host.sign(k, digest)
+        got = native.ecdsa_sign(k.d.to_bytes(32, "big"), digest)
+        assert got == want, f"sign divergence for key {i}"
+    # out-of-range keys are rejected, not signed
+    assert native.ecdsa_sign((host.N).to_bytes(32, "big"), b"\x11" * 32) is None
+    assert native.ecdsa_sign(b"\x00" * 32, b"\x11" * 32) is None
+
+
+def test_native_pubkey_matches_python():
+    host.set_native_pubkey(None)
+    for seed in (b"a", b"b", b"native-pub"):
+        k = PrivateKey.from_seed(seed)
+        out = native.ecdsa_pubkey(k.d.to_bytes(32, "big"))
+        assert out is not None
+        x, y = k.pubkey  # python path (native hook cleared above)
+        assert out == x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    assert native.ecdsa_pubkey((host.N).to_bytes(32, "big")) is None
+
+
 def test_native_install_fast_path():
     from go_ibft_tpu.crypto import keccak as keccak_mod
 
     assert native.install()
     try:
         assert keccak_mod.keccak256(b"installed") == native.keccak256(b"installed")
+        # the registered sign agrees with a fresh pure-Python computation
+        k = PrivateKey.from_seed(b"installed-sign")
+        digest = keccak256(b"payload")
+        via_hook = host.sign(k, digest)
+        host.set_native_sign(None)
+        assert host.sign(k, digest) == via_hook
     finally:
         keccak_mod.set_native_impl(None)
+        host.set_native_sign(None)
+        host.set_native_pubkey(None)

@@ -154,6 +154,24 @@ def _certify_kernel(zw, r, s, v, claimed_w, table_w, live, plo, phi, thr_lo, thr
     return ok, reached, lo, hi
 
 
+@jax.jit
+def _round_kernel(
+    zw, r, s, v, claimed_w, table_w, live, plo, phi, p_lo, p_hi, s_lo, s_hi
+):
+    """BOTH phases of a round in ONE dispatch (ops.quorum.round_certify
+    shape): the first half of the lanes are PREPARE envelopes (payload
+    digests), the second half COMMIT seals (broadcast proposal hash); one
+    shared recovery ladder, two separate quorum reductions with their own
+    thresholds (prepare carries the proposer credit)."""
+    ok = quorum.sig_checks_zw(zw, r, s, v, claimed_w, live)
+    eq = quorum.membership_eq(claimed_w, table_w)
+    ok = ok & jnp.any(eq, axis=-1)
+    b = zw.shape[0] // 2
+    p_reached, _, _ = quorum.power_reduce(ok[:b], eq[:b], plo, phi, p_lo, p_hi)
+    s_reached, _, _ = quorum.power_reduce(ok[b:], eq[b:], plo, phi, s_lo, s_hi)
+    return ok, p_reached, s_reached
+
+
 def _pack_scalars(values: List[int], pad_to: int) -> jnp.ndarray:
     values = values + [0] * (pad_to - len(values))
     return jnp.asarray(fields.to_limbs(values, sec.FIELD.nlimbs))
@@ -489,6 +507,92 @@ class DeviceBatchVerifier:
         )
         out[np.asarray(idxs)] = mask[: len(idxs)]
         return out, reached
+
+    def certify_round(
+        self,
+        msgs: Sequence[IbftMessage],
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        prepare_threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool, np.ndarray, bool]:
+        """Certify BOTH phases of a round in ONE device dispatch.
+
+        PREPARE envelopes and COMMIT seals share the recovery ladder, so
+        their lanes are concatenated (padded to one common bucket) and run
+        as a single program with two quorum reductions — the whole-round
+        certification shape (validating a prepared certificate plus its
+        committed seals at once; reference core/ibft.go:1161-1231).
+
+        Returns ``(sender_mask, prepare_reached, seal_mask, commit_reached)``.
+        Requires :meth:`supports_fused`.
+        """
+        table, (plo, phi, seal_thr), _ = self._fused_pack(height, None)
+        p_thr = seal_thr if prepare_threshold is None else prepare_threshold
+        sender_mask = np.zeros(len(msgs), dtype=bool)
+        seal_mask = np.zeros(len(seals), dtype=bool)
+        midx = [
+            i for i, m in enumerate(msgs) if self._well_formed_sender(m, height)
+        ]
+        sidx = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
+        if not midx or not sidx or len(proposal_hash) != 32:
+            # Degenerate rounds fall back to the per-phase paths (an empty
+            # half would break the kernel's split-at-half contract).
+            if midx:
+                sm, pr = self.certify_senders(
+                    msgs, height, threshold=prepare_threshold
+                )
+                sender_mask, p_ok = sm, pr
+            else:
+                p_ok = p_thr <= 0
+            if sidx:
+                cm, cr = self.certify_seals(proposal_hash, seals, height)
+                seal_mask, s_ok = cm, cr
+            else:
+                s_ok = seal_thr <= 0
+            return sender_mask, p_ok, seal_mask, s_ok
+
+        # Pack both halves to ONE common lane bucket so the kernel can
+        # split at half statically.
+        lanes = max(
+            _bucket(len(midx), _BATCH_BUCKETS), _bucket(len(sidx), _BATCH_BUCKETS)
+        )
+        t0 = time.perf_counter()
+        blocks, counts, r1, s1, v1, senders, live1 = pack_sender_batch(
+            [msgs[i] for i in midx], pad_lanes=lanes
+        )
+        zw1 = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
+        hz, r2, s2, v2, signers, live2 = pack_seal_batch(
+            proposal_hash, [seals[i] for i in sidx], pad_lanes=lanes
+        )
+        mask, p_reached, s_reached = _round_kernel(
+            jnp.concatenate([jnp.asarray(zw1), jnp.asarray(hz)], axis=0),
+            jnp.concatenate([jnp.asarray(r1), jnp.asarray(r2)], axis=0),
+            jnp.concatenate([jnp.asarray(s1), jnp.asarray(s2)], axis=0),
+            jnp.concatenate([jnp.asarray(v1), jnp.asarray(v2)], axis=0),
+            jnp.concatenate([jnp.asarray(senders), jnp.asarray(signers)], axis=0),
+            jnp.asarray(table),
+            jnp.concatenate([jnp.asarray(live1), jnp.asarray(live2)], axis=0),
+            jnp.asarray(plo),
+            jnp.asarray(phi),
+            jnp.int32(max(p_thr, 0) & 0xFFFF),
+            jnp.int32(max(p_thr, 0) >> 16),
+            jnp.int32(max(seal_thr, 0) & 0xFFFF),
+            jnp.int32(max(seal_thr, 0) >> 16),
+        )
+        mask = np.asarray(mask)
+        metrics.observe(
+            ("go-ibft", "device", "certify_round_ms"),
+            (time.perf_counter() - t0) * 1e3,
+        )
+        sender_mask[np.asarray(midx)] = mask[: len(midx)]
+        seal_mask[np.asarray(sidx)] = mask[lanes : lanes + len(sidx)]
+        return (
+            sender_mask,
+            bool(np.asarray(p_reached)),
+            seal_mask,
+            bool(np.asarray(s_reached)),
+        )
 
     # -- BatchVerifier protocol ----------------------------------------
 

@@ -51,9 +51,13 @@ def main() -> None:
     # bench.py owns the canonical argument packing for the fused kernels;
     # importing it keeps the warmed programs in lockstep with what the
     # bench and the engine actually dispatch.
-    from bench import _prep_args, _seal_args
+    from bench import _prep_args, _round_args, _seal_args
     from go_ibft_tpu.bench import build_round_workload
-    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+    from go_ibft_tpu.ops.quorum import (
+        quorum_certify,
+        round_certify,
+        seal_quorum_certify,
+    )
     from go_ibft_tpu.verify import DeviceBatchVerifier
 
     t0 = time.perf_counter()
@@ -65,6 +69,7 @@ def main() -> None:
         w = build_round_workload(n)
         quorum_certify(*_prep_args(w))[0].block_until_ready()
         seal_quorum_certify(*_seal_args(w))[0].block_until_ready()
+        round_certify(*_round_args(w))[0].block_until_ready()
         _stamp(f"quorum kernels @{n} validators", t0)
 
     t0 = time.perf_counter()

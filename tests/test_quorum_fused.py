@@ -148,3 +148,47 @@ def test_quorum_threshold_edge():
     assert int(w_edge.expected_prepare_mask.sum()) == 3
     _, reached_edge, _, _ = quorum_certify(*_prep_args(w_edge))
     assert bool(np.asarray(reached_edge))
+
+
+def _round_args(w):
+    """Both phases packed for the single-dispatch round_certify."""
+    blocks, counts, pr, ps, pv, senders, plive = w.prepare
+    hz, sr, ss, sv, signers, slive = w.seals
+    return (
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(pr),
+        jnp.asarray(ps),
+        jnp.asarray(pv),
+        jnp.asarray(senders),
+        jnp.asarray(plive),
+        jnp.asarray(hz),
+        jnp.asarray(sr),
+        jnp.asarray(ss),
+        jnp.asarray(sv),
+        jnp.asarray(signers),
+        jnp.asarray(slive),
+        jnp.asarray(w.table),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
+
+
+def test_round_certify_matches_split_kernels():
+    """The single-dispatch both-phases program must agree lane-for-lane
+    with quorum_certify + seal_quorum_certify, including corrupted lanes."""
+    from go_ibft_tpu.ops.quorum import round_certify
+
+    w = build_round_workload(8, corrupt_frac=0.25, seed=5)
+    pmask, preached, _, _ = quorum_certify(*_prep_args(w))
+    smask, sreached, _, _ = seal_quorum_certify(*_seal_args(w))
+    fp, fpr, fs, fsr = round_certify(*_round_args(w))
+    assert (np.asarray(fp) == np.asarray(pmask)).all()
+    assert (np.asarray(fs) == np.asarray(smask)).all()
+    assert bool(np.asarray(fpr)) == bool(np.asarray(preached))
+    assert bool(np.asarray(fsr)) == bool(np.asarray(sreached))
+    n = w.n_validators
+    assert (np.asarray(fp)[:n] == w.expected_prepare_mask).all()
+    assert (np.asarray(fs)[:n] == w.expected_seal_mask).all()

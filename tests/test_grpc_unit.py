@@ -1,0 +1,113 @@
+"""GrpcTransport failure-path behavior: dead peers, undecodable inbound
+bytes, clean shutdown with in-flight sends (fire-and-forget contract,
+reference core/transport.go:7-10)."""
+
+import asyncio
+
+import grpc
+
+from go_ibft_tpu.messages.wire import (
+    IbftMessage,
+    MessageType,
+    PrepareMessage,
+    View,
+)
+from go_ibft_tpu.net import GrpcTransport
+from go_ibft_tpu.net.grpc_transport import _FULL_METHOD
+
+
+class _Log:
+    def __init__(self):
+        self.lines = []
+
+    def info(self, *a):
+        pass
+
+    def debug(self, *a):
+        self.lines.append(a)
+
+    def error(self, *a):
+        self.lines.append(a)
+
+
+def _msg() -> IbftMessage:
+    return IbftMessage(
+        view=View(height=1, round=0),
+        sender=b"s00-----------------"[:20],
+        signature=b"\x01" * 65,
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=b"\x22" * 32),
+    )
+
+
+async def test_dead_peer_is_fire_and_forget():
+    """A peer that is down must not block or raise — self-delivery and live
+    peers proceed; the failure is logged at debug."""
+    log = _Log()
+    got = []
+    t = GrpcTransport("127.0.0.1:0", {}, got.append, logger=log)
+    await t.start()
+    try:
+        t.add_peer("dead", "127.0.0.1:1")  # nothing listens here
+        t.multicast(_msg())
+        assert len(got) == 1  # self-delivery is synchronous and unaffected
+        for _ in range(100):  # wait for the failed send task to settle
+            if not t._tasks:
+                break
+            await asyncio.sleep(0.05)
+        assert not t._tasks
+        assert log.lines, "dead-peer failure should be logged"
+    finally:
+        await t.stop()
+
+
+async def test_undecodable_inbound_bytes_logged_not_raised():
+    log = _Log()
+    got = []
+    t = GrpcTransport("127.0.0.1:0", {}, got.append, logger=log)
+    await t.start()
+    try:
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{t.bound_port}")
+        stub = channel.unary_unary(
+            _FULL_METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        await stub(b"\xff\xff\xff\x07garbage", timeout=5.0)
+        await channel.close()
+        assert got == []
+        assert log.lines, "undecodable inbound must be logged"
+    finally:
+        await t.stop()
+
+
+async def test_stop_cancels_inflight_sends():
+    t = GrpcTransport("127.0.0.1:0", {}, lambda m: None)
+    await t.start()
+    t.add_peer("slow", "10.255.255.1:9")  # unroutable: send will hang in connect
+    t.multicast(_msg())
+    assert t._tasks
+    await t.stop()  # must cancel the in-flight task and return promptly
+    assert not t._tasks
+
+
+async def test_roundtrip_between_two_transports():
+    got_a, got_b = [], []
+    ta = GrpcTransport("127.0.0.1:0", {}, got_a.append)
+    tb = GrpcTransport("127.0.0.1:0", {}, got_b.append)
+    await ta.start()
+    await tb.start()
+    try:
+        ta.add_peer("b", f"127.0.0.1:{tb.bound_port}")
+        tb.add_peer("a", f"127.0.0.1:{ta.bound_port}")
+        ta.multicast(_msg())
+        for _ in range(100):
+            if got_b:
+                break
+            await asyncio.sleep(0.02)
+        assert len(got_a) == 1  # self
+        assert len(got_b) == 1  # network hop
+        assert got_b[0].encode() == _msg().encode()
+    finally:
+        await ta.stop()
+        await tb.stop()

@@ -478,10 +478,13 @@ def config2_headline() -> None:
 
 
 def _guarded(config_fn, failures: list) -> None:
-    """Secondary configs must not take down the headline mid-run: report
-    the failure as a JSON line, keep going, and fail the process AFTER the
-    headline printed (main()).  The differential smoke and the headline
-    stay immediately fatal — a wrong kernel must never 'benchmark'."""
+    """Secondary configs must not take down the headline: report the
+    failure as a JSON line and keep going.  The differential smoke and the
+    headline stay immediately fatal — a wrong kernel must never
+    'benchmark'.  The process still exits 0 when the headline printed
+    (drivers record the final JSON line; rc!=0 would discard a valid
+    headline over a secondary hiccup) — CI gates on the ``error`` lines
+    instead (.github/workflows/main.yml tpu-perf)."""
     try:
         config_fn()
     except Exception as err:  # noqa: BLE001
@@ -533,8 +536,8 @@ def main() -> None:
                 }
             )
     config2_headline()  # headline LAST: drivers read the final JSON line
-    if failures:  # correctness gates tripped above: exit nonzero for CI
-        sys.exit(f"bench configs failed: {', '.join(failures)}")
+    if failures:  # diagnostics for CI; exit stays 0 — the headline printed
+        _log({"metric": "bench_failures", "value": failures})
 
 
 if __name__ == "__main__":

@@ -55,12 +55,38 @@ def main() -> None:
         jnp.int32(w.thr_lo),
         jnp.int32(w.thr_hi),
     )
+    # The single-dispatch whole-round program must be just as bit-stable.
+    from go_ibft_tpu.ops.quorum import round_certify
+
+    fmask, freached, fsmask, fsreached = round_certify(
+        jnp.asarray(blocks),
+        jnp.asarray(counts),
+        jnp.asarray(r),
+        jnp.asarray(s),
+        jnp.asarray(v),
+        jnp.asarray(senders),
+        jnp.asarray(live),
+        jnp.asarray(hz),
+        jnp.asarray(sr),
+        jnp.asarray(ss_),
+        jnp.asarray(sv),
+        jnp.asarray(signers),
+        jnp.asarray(slive),
+        jnp.asarray(w.table),
+        jnp.asarray(w.powers_lo),
+        jnp.asarray(w.powers_hi),
+        jnp.int32(w.thr_lo),
+        jnp.int32(w.thr_hi),
+    )
     json.dump(
         {
             "prepare_mask": np.asarray(mask).tolist(),
             "prepare": [bool(np.asarray(reached)), int(lo), int(hi)],
             "seal_mask": np.asarray(smask).tolist(),
             "seal": [bool(np.asarray(sreached)), int(slo), int(shi)],
+            "round_masks": np.asarray(fmask).tolist()
+            + np.asarray(fsmask).tolist(),
+            "round": [bool(np.asarray(freached)), bool(np.asarray(fsreached))],
         },
         sys.stdout,
         sort_keys=True,

@@ -39,23 +39,39 @@ class StdoutLogger:
         print(f"[error] {msg} {args if args else ''}")
 
 
-def build_cluster(n: int, use_device: bool):
+def build_cluster(n: int, use_device: bool, use_bls: bool = False):
     # 1. Validator identities and the (static) voting-power map.
     keys = [PrivateKey.from_seed(b"example-validator-%d" % i) for i in range(n)]
     powers = {k.address: 1 for k in keys}
     validators = ECDSABackend.static_validators(powers)
 
+    if use_bls:
+        # BLS committed seals: ECDSA envelopes + BLS G2 seals, so a whole
+        # COMMIT quorum certifies with ONE pairing (aggregate verification).
+        from go_ibft_tpu.crypto import bls as hbls
+        from go_ibft_tpu.crypto.bls_backend import HybridBLSBackend
+
+        bls_keys = [
+            hbls.BLSPrivateKey.from_seed(b"example-bls-%d" % i) for i in range(n)
+        ]
+        pubkeys = {
+            k.address: bk.pubkey for k, bk in zip(keys, bls_keys)
+        }
+        bls_src = ECDSABackend.static_validators(pubkeys)  # same snapshot shape
+
     # 2. One engine per validator, all wired to one loopback "network".
     transport = LoopbackTransport()
     engines = []
-    for key in keys:
-        backend = ECDSABackend(
-            key,
-            validators,
+    for i, key in enumerate(keys):
+        build = lambda view: b"example block %d" % view.height  # noqa: E731
+        if use_bls:
+            backend = HybridBLSBackend(
+                key, bls_keys[i], validators, bls_src, build_proposal_fn=build
+            )
+        else:
             # The embedder's block builder: anything bytes. A real chain
             # would assemble transactions here (reference Backend.BuildProposal).
-            build_proposal_fn=lambda view: b"example block %d" % view.height,
-        )
+            backend = ECDSABackend(key, validators, build_proposal_fn=build)
         batch_verifier = None
         if use_device:
             from go_ibft_tpu.verify import DeviceBatchVerifier
@@ -71,8 +87,10 @@ def build_cluster(n: int, use_device: bool):
     return engines
 
 
-async def main_async(n: int, heights: int, use_device: bool) -> None:
-    engines = build_cluster(n, use_device)
+async def main_async(
+    n: int, heights: int, use_device: bool, use_bls: bool = False
+) -> None:
+    engines = build_cluster(n, use_device, use_bls)
     try:
         for h in range(1, heights + 1):
             # Every validator runs the height concurrently; run_sequence
@@ -97,5 +115,10 @@ if __name__ == "__main__":
         action="store_true",
         help="verify PREPARE/COMMIT phases through the fused device kernels",
     )
+    ap.add_argument(
+        "--bls",
+        action="store_true",
+        help="BLS12-381 committed seals (one pairing certifies a quorum)",
+    )
     args = ap.parse_args()
-    asyncio.run(main_async(args.nodes, args.heights, args.device))
+    asyncio.run(main_async(args.nodes, args.heights, args.device, args.bls))

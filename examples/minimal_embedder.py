@@ -78,6 +78,17 @@ def build_cluster(n: int, use_device: bool, use_bls: bool = False):
 
             batch_verifier = DeviceBatchVerifier(validators)
             batch_verifier.warmup()  # node startup: never compile mid-round
+            if use_bls:
+                # An explicit batch_verifier overrides the backend's seal
+                # path, and BLS seals are 192 bytes — the ECDSA device
+                # verifier would reject every one.  Compose instead: device
+                # ECDSA for sender envelopes, BLS aggregate for seals.
+                from go_ibft_tpu.crypto.bls_backend import HybridBatchVerifier
+                from go_ibft_tpu.verify.bls import BLSAggregateVerifier
+
+                batch_verifier = HybridBatchVerifier(
+                    batch_verifier, BLSAggregateVerifier(bls_src)
+                )
         engine = IBFT(
             StdoutLogger(), backend, transport, batch_verifier=batch_verifier
         )

@@ -114,6 +114,7 @@ class PrivateKey:
 # tests/test_native.py); None falls through to pure Python.
 _native_sign: Optional[Callable[[bytes, bytes], Optional[Tuple[int, int, int]]]] = None
 _native_pubkey: Optional[Callable[[bytes], Optional[bytes]]] = None
+_native_recover: Optional[Callable[[bytes, bytes, int], Optional[bytes]]] = None
 
 
 def set_native_sign(
@@ -128,6 +129,14 @@ def set_native_pubkey(fn: Optional[Callable[[bytes], Optional[bytes]]]) -> None:
     """Register a native pubkey derivation; ``None`` restores pure Python."""
     global _native_pubkey
     _native_pubkey = fn
+
+
+def set_native_recover(
+    fn: Optional[Callable[[bytes, bytes, int], Optional[bytes]]]
+) -> None:
+    """Register a native ecrecover; ``None`` restores pure Python."""
+    global _native_recover
+    _native_recover = fn
 
 
 def sign(key: PrivateKey, digest: bytes) -> Tuple[int, int, int]:
@@ -182,6 +191,15 @@ def recover(digest: bytes, r: int, s: int, v: int) -> Optional[Tuple[int, int]]:
     """Public-key recovery; ``None`` on any invalid input."""
     if not (0 < r < N and 0 < s < N) or v not in (0, 1):
         return None
+    if _native_recover is not None:
+        out = _native_recover(
+            digest, r.to_bytes(32, "big") + s.to_bytes(32, "big"), v
+        )
+        return (
+            None
+            if out is None
+            else (int.from_bytes(out[:32], "big"), int.from_bytes(out[32:], "big"))
+        )
     x = r
     y2 = (x * x * x + 7) % P
     y = pow(y2, (P + 1) // 4, P)

@@ -166,7 +166,7 @@ def verify_batch_sequential(
 
 
 def install() -> bool:
-    """Register the native fast paths (keccak, sign, pubkey derivation).
+    """Register the native fast paths (keccak, sign, pubkey, recover).
 
     All are bit-identical to the pure-Python implementations
     (differential-tested); returns True when the native library is active."""
@@ -179,4 +179,5 @@ def install() -> bool:
     keccak_mod.set_native_impl(keccak256)
     ecdsa_mod.set_native_sign(ecdsa_sign)
     ecdsa_mod.set_native_pubkey(ecdsa_pubkey)
+    ecdsa_mod.set_native_recover(ecdsa_recover)
     return True

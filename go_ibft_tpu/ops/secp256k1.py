@@ -254,9 +254,21 @@ def _scalar_nibbles_msb(k: jnp.ndarray) -> jnp.ndarray:
 
 def _one_hot_select(sel: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
     """Branchless 16-way gather: ``table`` is ``(16, ..., L)`` (leading table
-    axis), ``sel`` integer in [0, 16); returns ``(..., L)``."""
-    oh = (jnp.arange(16) == sel[..., None]).astype(table.dtype)  # (..., 16)
-    return jnp.einsum("...k,k...l->...l", oh, table)
+    axis), ``sel`` integer in [0, 16); returns ``(..., L)``.
+
+    A 4-level select tree of pure ``where`` ops (15 selects), NOT a one-hot
+    ``einsum``: an int32 ``dot_general`` per scan step lowers poorly on TPU
+    (no MXU int path — each becomes a serialized VPU contraction with
+    layout shuffles), and this gather runs 6x per ladder step
+    (scripts/ab_ladder_select.py measures the two head-to-head)."""
+    b0 = (sel & 1).astype(bool)[..., None]
+    b1 = (sel & 2).astype(bool)[..., None]
+    b2 = (sel & 4).astype(bool)[..., None]
+    b3 = (sel & 8).astype(bool)[..., None]
+    t = [jnp.where(b0, table[i + 1], table[i]) for i in range(0, 16, 2)]
+    t = [jnp.where(b1, t[i + 1], t[i]) for i in range(0, 8, 2)]
+    t = [jnp.where(b2, t[i + 1], t[i]) for i in range(0, 4, 2)]
+    return jnp.where(b3, t[1], t[0])
 
 
 @jax.jit

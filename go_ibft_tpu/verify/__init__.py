@@ -1,19 +1,33 @@
 """Batched signature verification backends.
 
-Three interchangeable implementations of the
+Interchangeable implementations of the
 :class:`go_ibft_tpu.core.backend.BatchVerifier` protocol (SURVEY.md §7
 stage 4):
 
-* :class:`HostBatchVerifier` — sequential Python ints; the reference
+* :class:`HostBatchVerifier` — sequential per-message verification (native
+  C++ ecrecover when available, pure Python otherwise); the reference
   semantics oracle and the CI stand-in when no accelerator exists.
 * :class:`DeviceBatchVerifier` — one ``jit`` batch per phase on whatever
   JAX backend is active (TPU in production, CPU in tests); the framework's
   headline capability.
+* :class:`AdaptiveBatchVerifier` — routes tiny batches to the host path
+  and big ones to the device kernels (the dispatch-latency floor makes
+  device batching a loss below ~a dozen lanes).
 
-Both return identical boolean masks for identical inputs — determinism
+All return identical boolean masks for identical inputs — determinism
 across backends is part of the conformance suite.
 """
 
-from .batch import DeviceBatchVerifier, HostBatchVerifier, SIG_BYTES
+from .batch import (
+    AdaptiveBatchVerifier,
+    DeviceBatchVerifier,
+    HostBatchVerifier,
+    SIG_BYTES,
+)
 
-__all__ = ["DeviceBatchVerifier", "HostBatchVerifier", "SIG_BYTES"]
+__all__ = [
+    "AdaptiveBatchVerifier",
+    "DeviceBatchVerifier",
+    "HostBatchVerifier",
+    "SIG_BYTES",
+]

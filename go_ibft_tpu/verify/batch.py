@@ -105,6 +105,11 @@ class HostBatchVerifier:
         self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
     ) -> np.ndarray:
         out = np.zeros(len(seals), dtype=bool)
+        # Same malformed-hash rejection as ECDSABackend.is_valid_committed_seal
+        # and the device path (a seal signs a 32-byte keccak hash; the native
+        # recover also reads exactly 32 digest bytes).
+        if len(proposal_hash) != 32:
+            return out
         for i, seal in enumerate(seals):
             if len(seal.signer) != ADDRESS_BYTES or len(seal.signature) != SIG_BYTES:
                 continue
@@ -629,3 +634,133 @@ class DeviceBatchVerifier:
         )
         out[np.asarray(idxs)] = mask[: len(idxs)]
         return out
+
+
+class AdaptiveBatchVerifier:
+    """Host/device router: tiny batches on host, large ones on device.
+
+    SURVEY.md §7 hard part (d): a device round-trip has a fixed dispatch
+    latency floor that dwarfs a handful of native per-message recovers, so
+    a 4-validator cluster should never pay it — while a 100-validator
+    quorum drain absolutely should.  Batches with fewer than
+    ``cutover_lanes`` items run the sequential host path (native C++
+    ecrecover); everything else dispatches the fused device kernels.  Both
+    paths produce identical accept-sets (the differential suites pin this),
+    so the route is invisible to the engine.
+
+    Implements BOTH engine protocols (BatchVerifier + FusedBatchVerifier);
+    the host fallback computes the voting-power quorum with exact Python
+    ints, mirroring ops/quorum.py ``power_reduce`` semantics (distinct
+    validators counted once).
+    """
+
+    def __init__(
+        self,
+        validators_for_height: ValidatorSource,
+        cutover_lanes: int = 16,
+        device: Optional[DeviceBatchVerifier] = None,
+        host: Optional[HostBatchVerifier] = None,
+    ):
+        self._validators = validators_for_height
+        self.cutover = cutover_lanes
+        self.device = device if device is not None else DeviceBatchVerifier(validators_for_height)
+        self.host = host if host is not None else HostBatchVerifier(validators_for_height)
+
+    def warmup(self, **kw) -> None:
+        self.device.warmup(**kw)
+
+    # -- host-side quorum (exact big ints) ------------------------------
+
+    def _host_reached(
+        self, valid_addrs: Iterable[bytes], height: int, threshold: Optional[int]
+    ) -> bool:
+        powers = self._validators(height)
+        thr = (
+            calculate_quorum(sum(powers.values()))
+            if threshold is None
+            else threshold
+        )
+        if thr <= 0:
+            return True
+        got = sum(powers.get(a, 0) for a in set(valid_addrs))
+        return got >= thr
+
+    # -- BatchVerifier ---------------------------------------------------
+
+    def _host_sized(self, n: int) -> bool:
+        return n < self.cutover or n > _BATCH_BUCKETS[-1]
+
+    def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
+        if self._host_sized(len(msgs)):
+            return self.host.verify_senders(msgs)
+        return self.device.verify_senders(msgs)
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        if self._host_sized(len(seals)):
+            return self.host.verify_committed_seals(proposal_hash, seals, height)
+        return self.device.verify_committed_seals(proposal_hash, seals, height)
+
+    # -- FusedBatchVerifier ---------------------------------------------
+
+    def supports_fused(self, height: int) -> bool:
+        """Always true: batches the device range cannot represent exactly
+        (powers >= 2**31) are routed to the host big-int path instead."""
+        return True
+
+    def _route_device(self, n: int, height: int) -> bool:
+        # Above the largest pad bucket the device packers raise; the host
+        # path handles any size, so oversize floods route there too.
+        return (
+            self.cutover <= n <= _BATCH_BUCKETS[-1]
+            and self.device.supports_fused(height)
+        )
+
+    def certify_senders(
+        self, msgs: Sequence[IbftMessage], height: int, threshold: Optional[int] = None
+    ) -> Tuple[np.ndarray, bool]:
+        if self._route_device(len(msgs), height):
+            return self.device.certify_senders(msgs, height, threshold)
+        # Same height gate as the device path (certify is per-view).
+        mask = self.host.verify_senders(msgs)
+        for i, m in enumerate(msgs):
+            if m.view is None or m.view.height != height:
+                mask[i] = False
+        valid = [m.sender for m, ok in zip(msgs, mask) if ok]
+        return mask, self._host_reached(valid, height, threshold)
+
+    def certify_seals(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool]:
+        if self._route_device(len(seals), height):
+            return self.device.certify_seals(proposal_hash, seals, height, threshold)
+        mask = self.host.verify_committed_seals(proposal_hash, seals, height)
+        valid = [s.signer for s, ok in zip(seals, mask) if ok]
+        return mask, self._host_reached(valid, height, threshold)
+
+    def certify_round(
+        self,
+        msgs: Sequence[IbftMessage],
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        prepare_threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool, np.ndarray, bool]:
+        if (
+            self._route_device(max(len(msgs), len(seals)), height)
+            and msgs
+            and seals
+        ):
+            return self.device.certify_round(
+                msgs, proposal_hash, seals, height, prepare_threshold
+            )
+        sender_mask, p_ok = self.certify_senders(
+            msgs, height, threshold=prepare_threshold
+        )
+        seal_mask, s_ok = self.certify_seals(proposal_hash, seals, height)
+        return sender_mask, p_ok, seal_mask, s_ok

@@ -1,0 +1,220 @@
+"""AdaptiveBatchVerifier routing + host-quorum parity (fast tier).
+
+The router must (a) send sub-cutover batches to the host path and larger
+ones to the device path, (b) reproduce the device certify semantics
+(threshold credit, thr <= 0 edge, distinct-validator power counting) with
+exact host ints, and (c) stay protocol-compatible with the engine.  The
+device verifier here is a recording stub — the real-kernel differential
+lives in the slow tier.
+"""
+
+import numpy as np
+
+from go_ibft_tpu.core.backend import BatchVerifier, FusedBatchVerifier
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.messages.wire import IbftMessage, Proposal, View
+from go_ibft_tpu.verify import AdaptiveBatchVerifier, HostBatchVerifier
+
+
+class _RecordingDevice:
+    """Stub DeviceBatchVerifier: records calls, returns canned results."""
+
+    def __init__(self, fused: bool = True):
+        self.calls = []
+        self._fused = fused
+
+    def warmup(self, **kw):
+        self.calls.append(("warmup",))
+
+    def supports_fused(self, height):
+        return self._fused
+
+    def verify_senders(self, msgs):
+        self.calls.append(("verify_senders", len(msgs)))
+        return np.ones(len(msgs), dtype=bool)
+
+    def verify_committed_seals(self, proposal_hash, seals, height):
+        self.calls.append(("verify_seals", len(seals)))
+        return np.ones(len(seals), dtype=bool)
+
+    def certify_senders(self, msgs, height, threshold=None):
+        self.calls.append(("certify_senders", len(msgs), threshold))
+        return np.ones(len(msgs), dtype=bool), True
+
+    def certify_seals(self, proposal_hash, seals, height, threshold=None):
+        self.calls.append(("certify_seals", len(seals), threshold))
+        return np.ones(len(seals), dtype=bool), True
+
+    def certify_round(self, msgs, proposal_hash, seals, height, prepare_threshold=None):
+        self.calls.append(("certify_round", len(msgs), len(seals)))
+        return (
+            np.ones(len(msgs), dtype=bool),
+            True,
+            np.ones(len(seals), dtype=bool),
+            True,
+        )
+
+
+def _fixture(n=4, height=2, power=1):
+    keys = [PrivateKey.from_seed(b"adapt-%d" % i) for i in range(n)]
+    powers = {k.address: power for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=height, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"adaptive block", round=0))
+    msgs = [b.build_prepare_message(phash, view) for b in backends]
+    seals = []
+    for b in backends:
+        commit = b.build_commit_message(phash, view)
+        seals.append(
+            CommittedSeal(
+                signer=commit.sender,
+                signature=commit.commit_data.committed_seal,
+            )
+        )
+    return src, msgs, phash, seals, keys
+
+
+def _adaptive(src, cutover=16, fused=True):
+    dev = _RecordingDevice(fused=fused)
+    return AdaptiveBatchVerifier(src, cutover_lanes=cutover, device=dev), dev
+
+
+def test_protocol_compatibility():
+    src, *_ = _fixture()
+    av, _ = _adaptive(src)
+    assert isinstance(av, BatchVerifier)
+    assert isinstance(av, FusedBatchVerifier)
+
+
+def test_small_batches_never_touch_device():
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, dev = _adaptive(src, cutover=16)
+    mask = av.verify_senders(msgs)
+    smask = av.verify_committed_seals(phash, seals, height=2)
+    cmask, reached = av.certify_senders(msgs, height=2)
+    sm2, r2 = av.certify_seals(phash, seals, height=2)
+    assert dev.calls == []  # every call routed host
+    assert mask.all() and smask.all() and cmask.all() and sm2.all()
+    assert reached and r2
+
+
+def test_large_batches_route_to_device():
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, dev = _adaptive(src, cutover=3)  # 4 >= 3 -> device
+    av.verify_senders(msgs)
+    av.certify_senders(msgs, height=2)
+    av.certify_seals(phash, seals, height=2)
+    av.certify_round(msgs, phash, seals, height=2)
+    kinds = [c[0] for c in dev.calls]
+    assert kinds == [
+        "verify_senders",
+        "certify_senders",
+        "certify_seals",
+        "certify_round",
+    ]
+
+
+def test_device_unsupported_height_falls_back_to_host():
+    # Powers >= 2**31 are outside the device's exact integer range; the
+    # router must use host big ints even for large batches.
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2, power=1 << 40)
+    av, dev = _adaptive(src, cutover=1, fused=False)
+    mask, reached = av.certify_senders(msgs, height=2)
+    assert dev.calls == []
+    assert mask.all() and reached
+    assert av.supports_fused(2)  # adaptively always true
+
+
+def test_host_certify_matches_device_semantics():
+    """Threshold credit, thr<=0 edge, wrong-height gating, corrupt lane."""
+    src, msgs, phash, seals, keys = _fixture(n=4, height=2)
+    av, _ = _adaptive(src, cutover=16)
+
+    # corrupt one signature: mask pinpoints it, 3 of 4 still reaches
+    # quorum floor(2*4/3)+1 = 3
+    bad = msgs[1]
+    msgs = list(msgs)
+    msgs[1] = IbftMessage(
+        view=bad.view,
+        sender=bad.sender,
+        signature=b"\x07" * len(bad.signature),
+        type=bad.type,
+        prepare_data=bad.prepare_data,
+    )
+    mask, reached = av.certify_senders(msgs, height=2)
+    assert list(mask) == [True, False, True, True]
+    assert reached
+
+    # threshold override: 4 valid needed but only 3 valid lanes -> no quorum
+    _, reached_hi = av.certify_senders(msgs, height=2, threshold=4)
+    assert not reached_hi
+
+    # thr <= 0 edge: reached even with an empty batch
+    _, reached_zero = av.certify_senders([], height=2, threshold=0)
+    assert reached_zero
+
+    # wrong-height messages are gated out (device parity)
+    wrong = _fixture(n=4, height=9)[1]
+    wmask, wreached = av.certify_senders(wrong, height=2)
+    assert not wmask.any() and not wreached
+
+
+def test_duplicate_sender_counts_power_once():
+    src, msgs, phash, seals, keys = _fixture(n=4, height=2)
+    av, _ = _adaptive(src, cutover=16)
+    # the same (valid) message three times plus one other validator:
+    # distinct power = 2 < quorum 3
+    batch = [msgs[0], msgs[0], msgs[0], msgs[1]]
+    mask, reached = av.certify_senders(batch, height=2)
+    assert mask.all()
+    assert not reached
+
+
+def test_certify_round_host_path_combines_phases():
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, dev = _adaptive(src, cutover=16)
+    smask, p_ok, cmask, s_ok = av.certify_round(msgs, phash, seals, height=2)
+    assert dev.calls == []
+    assert smask.all() and cmask.all() and p_ok and s_ok
+
+
+def test_malformed_hash_rejected_on_both_routes():
+    """The accept-set must not depend on the route: a non-32-byte proposal
+    hash is rejected by the device path, so the host path (and
+    HostBatchVerifier itself) must reject it too."""
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, dev = _adaptive(src, cutover=16)
+    host = HostBatchVerifier(src)
+    for bad_hash in (b"", b"\x01" * 31, b"\x01" * 33):
+        assert not host.verify_committed_seals(bad_hash, seals, 2).any()
+        assert not av.verify_committed_seals(bad_hash, seals, 2).any()
+        mask, reached = av.certify_seals(bad_hash, seals, height=2)
+        assert not mask.any() and not reached
+    assert dev.calls == []
+
+
+def test_oversize_batches_route_to_host():
+    """Batches above the largest device pad bucket (2048) would raise in
+    the device packers; they must fall back to the host path."""
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, dev = _adaptive(src, cutover=3)  # device range is [3, 2048]
+    big = (msgs * 513)[:2049]
+    mask = av.verify_senders(big)
+    assert dev.calls == []  # oversize went host despite >= cutover
+    assert mask.all()
+    av.verify_senders(msgs)  # 4 lanes still routes device
+    assert [c[0] for c in dev.calls] == ["verify_senders"]
+
+
+def test_host_and_adaptive_masks_agree():
+    src, msgs, phash, seals, _ = _fixture(n=4, height=2)
+    av, _ = _adaptive(src, cutover=16)
+    host = HostBatchVerifier(src)
+    assert (av.verify_senders(msgs) == host.verify_senders(msgs)).all()
+    assert (
+        av.verify_committed_seals(phash, seals, 2)
+        == host.verify_committed_seals(phash, seals, 2)
+    ).all()

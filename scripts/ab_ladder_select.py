@@ -98,11 +98,18 @@ def _einsum_select(sel, table):
 
 
 def med(fn, *args, reps=10):
-    jax.block_until_ready(fn(*args))
+    """p50 with a real device->host readback each rep (block_until_ready
+    alone can be a lazy ack on tunneled backends)."""
+
+    def sync(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(leaf).ravel()[:1]
+
+    sync(fn(*args))
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        sync(fn(*args))
         ts.append((time.perf_counter() - t0) * 1e3)
     return round(statistics.median(ts), 3)
 

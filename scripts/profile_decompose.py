@@ -31,13 +31,23 @@ def _probe_backend(timeout_s: int = 120) -> bool:
 
 
 def med(fn, *args, reps: int = 10) -> float:
-    import jax
+    """p50 wall time forcing a real device->host readback each rep.
 
-    jax.block_until_ready(fn(*args))
+    ``block_until_ready`` alone can be a lazy ack on tunneled backends;
+    materializing one element of the (possibly pytree) result on host is
+    an end-to-end sync no transport can fake."""
+    import jax
+    import numpy as np
+
+    def sync(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(leaf).ravel()[:1]
+
+    sync(fn(*args))
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        sync(fn(*args))
         ts.append((time.perf_counter() - t0) * 1e3)
     return round(statistics.median(ts), 3)
 

@@ -1,0 +1,41 @@
+"""Fast-tier parity for the ladder's 16-way table gather.
+
+The double-scalar ladder selects window entries with a branchless 4-level
+``where`` tree (ops/secp256k1.py::_one_hot_select — see the dot_general
+lowering hazard documented there).  This pins its exact-gather semantics
+against plain indexing for both table shapes the ladder uses: the fixed
+``(16, L)`` G-table and the per-batch ``(16, B, L)`` Q-table.
+"""
+
+import numpy as np
+
+from go_ibft_tpu.ops.secp256k1 import _L, _one_hot_select
+
+import jax.numpy as jnp
+
+
+def test_fixed_table_gather_matches_indexing():
+    rng = np.random.default_rng(11)
+    table = jnp.asarray(rng.integers(0, 8191, (16, _L), np.int32))
+    sel = jnp.asarray(rng.integers(0, 16, (9,), np.int32))
+    out = np.asarray(_one_hot_select(sel, table))
+    ref = np.asarray(table)[np.asarray(sel)]
+    assert (out == ref).all()
+
+
+def test_batched_table_gather_matches_indexing():
+    rng = np.random.default_rng(12)
+    table = jnp.asarray(rng.integers(0, 8191, (16, 9, _L), np.int32))
+    sel = jnp.asarray(rng.integers(0, 16, (9,), np.int32))
+    out = np.asarray(_one_hot_select(sel, table))
+    ref = np.stack(
+        [np.asarray(table)[int(s), i] for i, s in enumerate(np.asarray(sel))]
+    )
+    assert (out == ref).all()
+
+
+def test_all_sixteen_digits_hit():
+    table = jnp.asarray(np.arange(16 * _L, dtype=np.int32).reshape(16, _L))
+    sel = jnp.asarray(np.arange(16, dtype=np.int32))
+    out = np.asarray(_one_hot_select(sel, table))
+    assert (out == np.asarray(table)).all()

@@ -14,6 +14,10 @@ Configs (BASELINE.json):
 
 Prints one JSON line per config; the HEADLINE line (config #2, the
 ``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST.
+When the TPU backend is unavailable the run degrades honestly: the fused
+kernels still execute on CPU under an explicit ``cpu_fallback_*`` smoke
+metric, but the headline key is never printed, the final line is an
+``error`` line, and the process exits nonzero.
 
 A differential correctness smoke (device masks vs the host crypto oracle,
 including corrupted lanes) runs BEFORE any timing: a wrong kernel can
@@ -45,9 +49,12 @@ def _reps() -> int:
 
 # Probe budget for the default (TPU) backend before falling back to CPU.
 # The tunneled axon backend has been observed to HANG on init (not fail
-# fast), so the probe runs in a subprocess with a hard timeout.
+# fast), so the probe runs in a subprocess with a hard timeout.  Retries
+# back off exponentially (5s, 15s, 45s, ...): tunnel outages observed so
+# far are either instant-fail or multi-hour, so a few spaced retries catch
+# the transient cases without blowing the driver budget.
 _PROBE_TIMEOUT_S = int(os.environ.get("GO_IBFT_BENCH_PROBE_TIMEOUT", "240"))
-_PROBE_ATTEMPTS = int(os.environ.get("GO_IBFT_BENCH_PROBE_ATTEMPTS", "2"))
+_PROBE_ATTEMPTS = int(os.environ.get("GO_IBFT_BENCH_PROBE_ATTEMPTS", "3"))
 
 
 def _log(obj) -> None:
@@ -79,21 +86,39 @@ def ensure_live_backend() -> str:
                 timeout=_PROBE_TIMEOUT_S,
             )
         except subprocess.TimeoutExpired:
-            _log({"metric": "backend_probe", "attempt": attempt, "error": "timeout"})
-            continue
-        for line in out.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1]
-        _log(
-            {
-                "metric": "backend_probe",
-                "attempt": attempt,
-                "error": (out.stderr.strip().splitlines() or ["no output"])[-1][:200],
-            }
-        )
-        time.sleep(5)
+            # "probe_error", not "error": CI fails the bench job on any
+            # '"error"' line, and a transient probe miss that a retry
+            # recovers from must not fail an otherwise-valid run.
+            _log({"metric": "backend_probe", "attempt": attempt, "probe_error": "timeout"})
+        else:
+            for line in out.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1]
+            _log(
+                {
+                    "metric": "backend_probe",
+                    "attempt": attempt,
+                    "probe_error": (out.stderr.strip().splitlines() or ["no output"])[-1][:200],
+                }
+            )
+        if attempt < _PROBE_ATTEMPTS - 1:  # no dead sleep after the last try
+            time.sleep(5 * 3**attempt)
     jax.config.update("jax_platforms", "cpu")
     return "cpu (fallback: default backend unavailable)"
+
+
+def headline_metric(fallback: bool) -> str:
+    """Metric key for config #2's timing line.
+
+    A CPU fallback must NEVER publish the headline key: a dead tunnel once
+    shipped a round with a 7.4s CPU number on the headline metric and rc=0,
+    which read as "perf evidence" (BENCH_r03.json).  The fallback smoke
+    keeps the same measurement shape under an explicitly-degraded key;
+    main() follows it with an ``error`` line and a nonzero exit.
+    """
+    if fallback:
+        return "cpu_fallback_fused_smoke_p50_100v"
+    return "prepare_commit_quorum_verify_p50_100v"
 
 
 def _prep_args(w):
@@ -461,8 +486,66 @@ def config2_headline() -> None:
         baseline_name = "pure-Python sequential per-message verify"
         assert hm1.all() and hm2.all()
 
+    if not _FALLBACK:
+        # Calibrate the adaptive host/device router from THIS run: device
+        # dispatch floor vs measured host per-verify cost (VERDICT r03 #7:
+        # the cutover must be measured, not asserted).  The floor is timed
+        # through the REAL DeviceBatchVerifier.verify_senders path — host
+        # packing, transfer, dispatch, readback — on the smallest bucket,
+        # because that is exactly the cost the router's decision trades
+        # against N sequential host verifies.  Guarded: a calibration
+        # hiccup (read-only $HOME, compile failure) must never cost the
+        # run its headline evidence.
+        try:
+            from go_ibft_tpu.utils import calibration
+            from go_ibft_tpu.verify import DeviceBatchVerifier
+            from go_ibft_tpu.verify.batch import _BATCH_BUCKETS
+
+            dev = DeviceBatchVerifier(src)
+            small = prepares[:8]
+            dev.verify_senders(small)  # compile outside the timer
+            floor_times = []
+            for _ in range(_reps()):
+                t0 = time.perf_counter()
+                dev.verify_senders(small)
+                floor_times.append((time.perf_counter() - t0) * 1e3)
+            device_floor_ms = statistics.median(floor_times)
+            host_per_verify_ms = host_ms / 200  # 100 prepares + 100 seals
+            cutover = calibration.derive_cutover(
+                device_floor_ms, host_per_verify_ms, _BATCH_BUCKETS[-1]
+            )
+            calibration.save_calibration(
+                {
+                    "platform": jax.devices()[0].platform,
+                    "device_floor_ms": round(device_floor_ms, 4),
+                    "host_per_verify_ms": round(host_per_verify_ms, 5),
+                    "cutover_lanes": cutover,
+                    "source": "bench.py config2 (end-to-end verify_senders @8)",
+                }
+            )
+            _log(
+                {
+                    "metric": "adaptive_cutover_calibration",
+                    "value": cutover,
+                    "unit": "lanes",
+                    "vs_baseline": None,
+                    "device_floor_ms": round(device_floor_ms, 4),
+                    "host_per_verify_ms": round(host_per_verify_ms, 5),
+                }
+            )
+        except Exception as err:  # noqa: BLE001 - calibration is best-effort
+            _log(
+                {
+                    "metric": "adaptive_cutover_calibration",
+                    "value": None,
+                    "unit": "lanes",
+                    "vs_baseline": None,
+                    "calibration_error": f"{type(err).__name__}: {err}"[:200],
+                }
+            )
+
     line = {
-        "metric": "prepare_commit_quorum_verify_p50_100v",
+        "metric": headline_metric(_FALLBACK),
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(host_ms / p50, 2),
@@ -514,7 +597,11 @@ def main() -> None:
     from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
 
     platform = ensure_live_backend()
-    _FALLBACK = platform.startswith("cpu (fallback")
+    # Degraded unless the live platform IS a TPU ("axon" = the tunneled TPU
+    # PJRT plugin).  Keying off probe failure alone would let a container
+    # whose default backend is natively CPU publish the headline with rc=0
+    # — the same evidence hole as a dead tunnel, through a different door.
+    _FALLBACK = platform not in ("tpu", "axon")
     enable_persistent_cache()
     _log({"metric": "bench_platform", "value": platform})
     differential_smoke()
@@ -540,6 +627,34 @@ def main() -> None:
     config2_headline()  # headline LAST: drivers read the final JSON line
     if failures:  # diagnostics for CI; exit stays 0 — the headline printed
         _log({"metric": "bench_failures", "value": failures})
+    if _FALLBACK:
+        # Honest failure: the target platform never came up, so there is no
+        # headline number this run.  The CPU smoke above is evidence the
+        # kernels still execute, not perf evidence.  Nonzero rc + an
+        # "error" line (the CI gate greps for it) make the degradation
+        # impossible to mistake for a result.  The reason distinguishes a
+        # dead tunnel from a host that simply has no TPU backend — they
+        # have different fixes.
+        if platform.startswith("cpu (fallback"):
+            reason = (
+                "TPU backend unavailable (probe failed after "
+                f"{_PROBE_ATTEMPTS} attempts x {_PROBE_TIMEOUT_S}s)"
+            )
+        else:
+            reason = f"default JAX backend is {platform!r} — not a TPU"
+        _log(
+            {
+                "metric": "bench_error",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "error": (
+                    f"{reason}; no headline measurement (CPU smoke lines "
+                    "above are not perf evidence)"
+                ),
+            }
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,80 @@
+"""Measured host/device routing constants.
+
+The AdaptiveBatchVerifier's cutover must come from measurement, not
+assertion (VERDICT r03 weak #5): the crossover lane count is
+``device_dispatch_floor / host_per_verify_cost``, both of which depend on
+the actual chip, tunnel, and host CPU.  ``bench.py`` measures both on the
+target platform and persists them here; verifier construction reads them.
+
+The file lives next to the persistent XLA cache — same lifecycle: valid
+until the hardware or the kernels change, cheap to regenerate (one bench
+run), absent on a fresh checkout (the verifier then uses a conservative
+static default).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_DEFAULT_PATH = os.path.expanduser("~/.cache/go_ibft_tpu/calibration.json")
+
+# Conservative static fallback when no measurement exists: past the
+# smallest pad bucket the fused dispatch has historically beaten the
+# native host loop on a live chip (docs/PERFORMANCE.md); a wrong guess
+# here costs latency, never correctness (both routes are differential-
+# tested equal).
+DEFAULT_CUTOVER_LANES = 16
+
+
+def _path() -> str:
+    return os.environ.get("GO_IBFT_CALIBRATION_FILE", _DEFAULT_PATH)
+
+
+def load_calibration() -> Optional[dict]:
+    """The persisted measurement record, or None."""
+    try:
+        with open(_path()) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def save_calibration(record: dict) -> None:
+    path = _path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(record, fh, indent=1)
+
+
+def derive_cutover(
+    device_floor_ms: float, host_per_verify_ms: float, max_lanes: int
+) -> int:
+    """Crossover lane count: smallest batch where the (latency-bound,
+    lane-count-flat) device dispatch beats ``n`` sequential host verifies."""
+    if host_per_verify_ms <= 0:
+        return DEFAULT_CUTOVER_LANES
+    n = int(device_floor_ms / host_per_verify_ms) + 1
+    return max(1, min(n, max_lanes))
+
+
+def measured_cutover() -> Optional[int]:
+    """Cutover from the persisted measurement, if one exists.
+
+    Records measured on a non-TPU platform are ignored: a CPU "device
+    floor" is enormous and would derive a cutover that silently disables
+    the device path on a later live-TPU run sharing the same home dir.
+    (bench.py only saves on TPU runs; this is the belt to that suspender —
+    checked against the record, not ``jax.default_backend()``, so verifier
+    construction never forces backend init, which can HANG on a dead
+    tunnel.)
+    """
+    record = load_calibration()
+    if record is None:
+        return None
+    if record.get("platform") not in ("tpu", "axon"):
+        return None
+    value = record.get("cutover_lanes")
+    return value if isinstance(value, int) and value >= 1 else None

@@ -657,11 +657,21 @@ class AdaptiveBatchVerifier:
     def __init__(
         self,
         validators_for_height: ValidatorSource,
-        cutover_lanes: int = 16,
+        cutover_lanes: Optional[int] = None,
         device: Optional[DeviceBatchVerifier] = None,
         host: Optional[HostBatchVerifier] = None,
     ):
+        from ..utils import calibration
+
         self._validators = validators_for_height
+        if cutover_lanes is None:
+            # Measurement first (bench.py persists the device-dispatch
+            # floor vs host per-verify crossover for THIS platform), static
+            # conservative default only when no measurement exists.
+            cutover_lanes = (
+                calibration.measured_cutover()
+                or calibration.DEFAULT_CUTOVER_LANES
+            )
         self.cutover = cutover_lanes
         self.device = device if device is not None else DeviceBatchVerifier(validators_for_height)
         self.host = host if host is not None else HostBatchVerifier(validators_for_height)

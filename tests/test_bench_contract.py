@@ -1,0 +1,53 @@
+"""Contract tests for bench.py's evidence honesty.
+
+The bench is the round's perf evidence pipeline; these pin the rules that
+keep a degraded run from masquerading as a result (VERDICT r03 weak #3):
+
+* the headline metric key is reserved for the intended (TPU) platform —
+  a CPU fallback publishes an explicitly-degraded smoke key instead;
+* a fallback run ends with an ``error`` JSON line and nonzero rc (the CI
+  gate greps for ``"error"``: .github/workflows/main.yml tpu-perf).
+"""
+
+import ast
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import bench
+
+
+def test_headline_key_reserved_for_target_platform():
+    assert bench.headline_metric(False) == "prepare_commit_quorum_verify_p50_100v"
+    assert bench.headline_metric(True) != bench.headline_metric(False)
+    assert "fallback" in bench.headline_metric(True)
+
+
+def test_fallback_path_exits_nonzero_with_error_line():
+    """Static check: main()'s fallback branch logs an 'error' key and calls
+    sys.exit with a nonzero code.  (Running the real fallback path costs
+    minutes of kernel compiles; the structure is what the contract is.)"""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    main_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "main"
+    )
+    src = ast.unparse(main_fn)
+    assert "sys.exit(1)" in src
+    assert "'error'" in src or '"error"' in src
+    # the error line + exit are guarded by the fallback flag
+    assert "_FALLBACK" in src
+
+
+def test_probe_retries_use_probe_error_key():
+    """Transient probe misses must not trip CI's '"error"' grep when a
+    retry recovers — the probe logs under 'probe_error'."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    fn = next(
+        n
+        for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name == "ensure_live_backend"
+    )
+    src = ast.unparse(fn)
+    assert "probe_error" in src
+    assert "'error'" not in src and '"error"' not in src

@@ -54,6 +54,7 @@ __all__ = [
     "sqr",
     "muli",
     "pow_fixed",
+    "select16",
     "inv",
     "canon",
     "is_zero",
@@ -263,23 +264,65 @@ def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(cond[..., None], a, b)
 
 
+def select16(sel: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """Branchless 16-way gather: ``table`` is ``(16, ..., L)`` (leading
+    table axis), ``sel`` integer in [0, 16); returns ``(..., L)``.
+
+    A 4-level tree of pure ``where`` ops (15 selects), NOT a one-hot
+    ``einsum``: an int32 ``dot_general`` inside a scan body lowers poorly
+    on TPU (no MXU int path — each becomes a serialized VPU contraction
+    with layout shuffles; measured r03, scripts/ab_ladder_select.py)."""
+    b0 = (sel & 1).astype(bool)[..., None]
+    b1 = (sel & 2).astype(bool)[..., None]
+    b2 = (sel & 4).astype(bool)[..., None]
+    b3 = (sel & 8).astype(bool)[..., None]
+    t = [jnp.where(b0, table[i + 1], table[i]) for i in range(0, 16, 2)]
+    t = [jnp.where(b1, t[i + 1], t[i]) for i in range(0, 8, 2)]
+    t = [jnp.where(b2, t[i + 1], t[i]) for i in range(0, 4, 2)]
+    return jnp.where(b3, t[1], t[0])
+
+
 def pow_fixed(m: Modulus, a: jnp.ndarray, exponent: int) -> jnp.ndarray:
-    """a**exponent with a fixed public exponent, via an MSB-first scan."""
+    """a**exponent with a fixed public exponent: 4-bit windowed scan.
+
+    Left-to-right 2**4-ary exponentiation — per window 4 squarings + ONE
+    table multiply (14 table-build muls up front), ~5.1 sequential muls
+    per 4 bits vs the bit-serial square-and-multiply's 8 (that variant
+    computes the conditional multiply unconditionally under a ``select``
+    every step).  The scan body closes over the batch power table; window
+    digits are static scan inputs, gathered via :func:`select16`.  This is
+    the latency shape of the three per-recover Fermat scans the VERDICT r03
+    flagged (s⁻¹, √, affine inverse).
+    """
     if exponent < 0:
         raise ValueError("exponent must be non-negative")
     if exponent == 0:
         return jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
-    nbits = exponent.bit_length()
-    bits = jnp.asarray(
-        [(exponent >> i) & 1 for i in range(nbits - 2, -1, -1)], dtype=bool
-    )
+    nwin = -(-exponent.bit_length() // 4)
+    digits = np.asarray(
+        [(exponent >> (4 * j)) & 0xF for j in range(nwin - 1, -1, -1)],
+        dtype=np.int32,
+    )  # MSB-first
 
-    def body(acc, bit):
-        acc = mul(m, acc, acc)
-        acc = select(jnp.broadcast_to(bit, acc.shape[:-1]), mul(m, acc, a), acc)
+    # Power table a^0..a^15 built with a 14-step scan, NOT unrolled: every
+    # unrolled mul is ~10^2 HLO ops, and this table appears inside already-
+    # huge fused programs — trace size is compile time on XLA:CPU.
+    one = jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
+
+    def tab_body(prev, _):
+        nxt = mul(m, prev, a)
+        return nxt, nxt
+
+    _, tail = jax.lax.scan(tab_body, a, None, length=14)  # a^2 .. a^15
+    table = jnp.concatenate([one[None], a[None], tail])  # (16, ..., L)
+
+    def body(acc, digit):
+        for _ in range(4):
+            acc = mul(m, acc, acc)
+        acc = mul(m, acc, select16(digit, table))
         return acc, None
 
-    acc, _ = jax.lax.scan(body, a, bits)
+    acc, _ = jax.lax.scan(body, select16(jnp.asarray(digits[0]), table), jnp.asarray(digits[1:]))
     return acc
 
 

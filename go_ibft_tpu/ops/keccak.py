@@ -147,8 +147,11 @@ def _pallas_mode() -> str:
     flag = os.environ.get("GO_IBFT_PALLAS", "")
     if flag == "interpret":
         return "interpret"
-    if flag == "1" and jax.default_backend() == "tpu":
-        return "compiled"
+    if flag == "1":
+        from .pallas_keccak import pallas_supported  # the single predicate
+
+        if pallas_supported():
+            return "compiled"
     return ""
 
 

@@ -39,8 +39,14 @@ _TILE = 128  # batch tile per grid step: the VPU lane width
 
 
 def pallas_supported() -> bool:
-    """True when the active backend can run this kernel compiled (TPU)."""
-    return jax.default_backend() == "tpu"
+    """True when the active backend can run this kernel compiled (TPU).
+
+    "axon" is the tunneled TPU PJRT plugin — same Mosaic compile path.
+    This is THE predicate for compiled-vs-interpret dispatch; keccak's
+    ``_pallas_mode`` and the A/B + warm scripts all route through it so
+    they can never disagree about which variant actually runs.
+    """
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def _rotl_halves(lo, hi, n: int):

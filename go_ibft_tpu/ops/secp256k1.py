@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import fields
-from .fields import LIMB_BITS, Modulus
+from .fields import LIMB_BITS, LIMB_MASK, Modulus
 
 __all__ = [
     "P",
@@ -50,6 +50,7 @@ __all__ = [
     "is_infinity",
     "on_curve",
     "ecmul2_base",
+    "glv_split",
     "ecdsa_verify",
     "ecdsa_recover",
 ]
@@ -203,6 +204,148 @@ def point_add_mixed(
 _WINDOW = 4
 _NWIN = 64  # 256 / 4
 
+# ---------------------------------------------------------------------------
+# GLV endomorphism (secp256k1 has CM discriminant -3): phi(x, y) = (BETA*x, y)
+# acts as scalar multiplication by LAMBDA, where BETA**3 == 1 (mod P) and
+# LAMBDA**3 == 1 (mod N).  Splitting a scalar k = k1 + k2*LAMBDA with
+# |k1|, |k2| < 2**129 halves the ladder length: 33 four-bit windows over
+# FOUR half-length digit streams (G, phi(G), Q, phi(Q)) instead of 64
+# windows over two full-length ones — 132 shared doublings instead of 256.
+# Constants derived via extended Euclid on (N, LAMBDA) (GLV method; see
+# /tmp-free derivation in tests/test_secp256k1.py::test_glv_constants).
+# ---------------------------------------------------------------------------
+_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+_BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+# Short lattice basis v1 = (A1, B1), v2 = (A2, B2) of
+# {(x, y) : x + y*LAMBDA === 0 (mod N)}.
+_GLV_A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+_GLV_B2 = 0x3086D221A7D46BCDE86C90E49284EB15
+# Rounding multipliers: round(k * Gi / 2**384) == round(k * |b_i| / N)
+# exactly for all k < N (384 bits of precision leave the rounded quotient
+# off by at most 1, which the +-2**129 half-scalar bound absorbs; validated
+# exhaustively against the exact-rational formula in tests).
+_GLV_SHIFT = 384
+_GLV_G1 = (_GLV_B2 * (1 << _GLV_SHIFT) + N // 2) // N
+_GLV_G2 = (-_GLV_B1 * (1 << _GLV_SHIFT) + N // 2) // N
+
+assert pow(_LAMBDA, 3, N) == 1 and _LAMBDA != 1
+assert pow(_BETA, 3, P) == 1 and _BETA != 1
+assert (_GLV_A1 + _GLV_B1 * _LAMBDA) % N == 0
+assert (_GLV_A2 + _GLV_B2 * _LAMBDA) % N == 0
+
+_GLV_HL = 11  # half-scalar limb count: 143 bits >= 129-bit magnitude + sign
+_GLV_NWIN = 33  # 4-bit windows covering 132 bits
+_GLV_G1_L = fields.to_limbs([_GLV_G1], _L)[0]
+_GLV_G2_L = fields.to_limbs([_GLV_G2], _L)[0]
+_GLV_A1_L = fields.to_limbs([_GLV_A1], _GLV_HL)[0]
+_GLV_A2_L = fields.to_limbs([_GLV_A2], _GLV_HL)[0]
+_GLV_NB1_L = fields.to_limbs([-_GLV_B1], _GLV_HL)[0]
+_GLV_B2_L = fields.to_limbs([_GLV_B2], _GLV_HL)[0]
+# k*G fits 512 bits; + the 2**383 rounding addend stays under 13*41 bits.
+_GLV_PROD_LEN = 41
+_GLV_ROUND = np.zeros(_GLV_PROD_LEN, dtype=np.int32)
+_GLV_ROUND[_GLV_SHIFT // LIMB_BITS] = 1 << (_GLV_SHIFT % LIMB_BITS - 1)
+
+
+def _glv_round_shift(k: jnp.ndarray, g_limbs: np.ndarray) -> jnp.ndarray:
+    """``round((k * g) / 2**384)`` exactly, as an ``(..., 11)`` limb vector.
+
+    ``k`` canonical ``(..., 20)``; ``g`` a static 256-bit constant.  The
+    full 533-bit product is normalized (lazy carries + Kogge-Stone exact
+    pass — no sequential limb scan), then bits >= 384 are re-packed into
+    13-bit limbs."""
+    z = fields._conv(k, jnp.asarray(g_limbs), _GLV_PROD_LEN)
+    z = z + jnp.asarray(_GLV_ROUND)
+    z = fields._carry(z, 4)
+    z = fields._ks_carry(z)
+    base = _GLV_SHIFT // LIMB_BITS  # 29, shift-within-limb 7
+    lo = z[..., base : base + _GLV_HL] >> 7
+    hi = (z[..., base + 1 : base + 1 + _GLV_HL] << 6) & LIMB_MASK
+    return lo | hi
+
+
+def _q_window_table(
+    batch: Tuple[int, ...], qx: jnp.ndarray, qy: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-batch window table ``T[d] = d*Q`` (Jacobian; T[0] = infinity),
+    stacked as ``(16, ..., L)`` coordinate arrays.
+
+    Built with a 14-step ``lax.scan`` rather than 14 unrolled mixed adds:
+    each unrolled add is ~2*10^3 HLO ops and the table sits inside the
+    repo's largest fused programs — on XLA:CPU trace size IS compile time
+    (an unrolled table pushed the fused certify compile past 25 minutes).
+    """
+    one = jnp.asarray(FIELD.const(1))
+    q_pt = JacobianPoint(qx, qy, jnp.broadcast_to(one, batch + (_L,)))
+    inf = point_infinity(batch)
+
+    def tab_body(prev, _):
+        nxt = point_add_mixed(prev, qx, qy)
+        return nxt, nxt
+
+    _, tail = jax.lax.scan(tab_body, q_pt, None, length=14)  # 2Q .. 15Q
+    qtx = jnp.concatenate([inf.x[None], q_pt.x[None], tail.x])
+    qty = jnp.concatenate([inf.y[None], q_pt.y[None], tail.y])
+    qtz = jnp.concatenate([inf.z[None], q_pt.z[None], tail.z])
+    return qtx, qty, qtz
+
+
+def _conv_lo(a: jnp.ndarray, b: np.ndarray, n: int) -> jnp.ndarray:
+    """Low ``n`` limb-columns of the schoolbook product (mod-2**(13n) conv).
+
+    :func:`fields._conv` requires ``out_len >= la + lb - 1``; the GLV signed
+    combinations only need the value mod 2**143, so columns >= n are never
+    formed (keeps every column sum < 2**31 in int32).
+    """
+    b = jnp.asarray(b)
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros(batch + (n,), dtype=jnp.int32)
+    for i in range(min(a.shape[-1], n)):
+        seg = b[..., : n - i]
+        term = jnp.broadcast_to(a[..., i : i + 1] * seg, batch + (seg.shape[-1],))
+        pad = [(0, 0)] * len(batch) + [(i, n - i - seg.shape[-1])]
+        acc = acc + jnp.pad(term, pad)
+    return acc
+
+
+def _glv_neg143(r: jnp.ndarray) -> jnp.ndarray:
+    """``2**143 - r`` for ``0 < r < 2**143`` in 11 canonical limbs."""
+    flipped = LIMB_MASK - r
+    flipped = flipped.at[..., 0].add(1)
+    return fields._exact_carry(flipped)
+
+
+def glv_split(
+    k: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Decompose canonical ``k < N`` into ``k === s1*|k1| + s2*|k2|*LAMBDA``.
+
+    Returns ``(abs1, neg1, abs2, neg2)``: magnitudes as ``(..., 11)`` limb
+    vectors < 2**129 and sign flags (True = negative).  All arithmetic is
+    exact: the signed combinations are evaluated mod 2**143 in int32 limb
+    space (conv columns stay < 2**31) and the sign read off bit 142.
+    """
+    c1 = _glv_round_shift(k, _GLV_G1_L)
+    c2 = _glv_round_shift(k, _GLV_G2_L)
+
+    def signed(parts):
+        s = parts[0]
+        for term in parts[1:]:
+            s = s + term
+        r = fields._exact_carry(s)  # >> and & floor correctly on negatives
+        neg = (r[..., _GLV_HL - 1] >> 12) == 1
+        return fields.select(neg, _glv_neg143(r), r), neg
+
+    t1 = _conv_lo(c1, _GLV_A1_L, _GLV_HL)
+    t2 = _conv_lo(c2, _GLV_A2_L, _GLV_HL)
+    abs1, neg1 = signed([k[..., :_GLV_HL], -t1, -t2])  # k - c1*a1 - c2*a2
+    u1 = _conv_lo(c1, _GLV_NB1_L, _GLV_HL)
+    u2 = _conv_lo(c2, _GLV_B2_L, _GLV_HL)
+    abs2, neg2 = signed([u1, -u2])  # -c1*b1 - c2*b2
+    return abs1, neg1, abs2, neg2
+
 
 def _precompute_g_table() -> Tuple[np.ndarray, np.ndarray]:
     """Fixed-base window table: entry [d] = d * G, affine, d in 1..15.
@@ -231,6 +374,30 @@ def _precompute_g_table() -> Tuple[np.ndarray, np.ndarray]:
 
 _G_TAB_X, _G_TAB_Y = _precompute_g_table()
 
+
+def _precompute_glv_g_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """GLV companions to the fixed-base window table.
+
+    ``phi`` maps affine ``(x, y)`` to ``(BETA*x, y)`` and commutes with
+    scalar multiplication, so the ``d*phi(G)`` table is the ``d*G`` table
+    with x scaled by BETA (shared y).  Negative half-scalars flip the point
+    sign, so the negated-y table ``P - y`` is precomputed too (entry 0 is
+    the unused infinity placeholder).
+    """
+    from .fields import from_limbs, to_limbs
+
+    gpx = np.zeros((16, _L), dtype=np.int32)
+    gny = np.zeros((16, _L), dtype=np.int32)
+    xs = from_limbs(_G_TAB_X)
+    ys = from_limbs(_G_TAB_Y)
+    for d in range(1, 16):
+        gpx[d] = to_limbs([(_BETA * xs[d]) % P], _L)[0]
+        gny[d] = to_limbs([(P - ys[d]) % P], _L)[0]
+    return gpx, gny
+
+
+_GP_TAB_X, _G_TAB_NY = _precompute_glv_g_tables()
+
 # Static nibble-extraction indices: bit position 4j may straddle a 13-bit
 # limb boundary; precompute (limb, shift, need-hi) per window.
 _NIB_POS = np.arange(_NWIN - 1, -1, -1) * _WINDOW  # MSB-first
@@ -252,54 +419,51 @@ def _scalar_nibbles_msb(k: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(nib, -1, 0)
 
 
-def _one_hot_select(sel: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
-    """Branchless 16-way gather: ``table`` is ``(16, ..., L)`` (leading table
-    axis), ``sel`` integer in [0, 16); returns ``(..., L)``.
+# Branchless 16-way gather (4-level where tree, NOT a one-hot einsum — an
+# int32 dot_general per scan step lowers poorly on TPU; measured r03,
+# scripts/ab_ladder_select.py).  Now shared with fields.pow_fixed.
+_one_hot_select = fields.select16
 
-    A 4-level select tree of pure ``where`` ops (15 selects), NOT a one-hot
-    ``einsum``: an int32 ``dot_general`` per scan step lowers poorly on TPU
-    (no MXU int path — each becomes a serialized VPU contraction with
-    layout shuffles), and this gather runs 6x per ladder step
-    (scripts/ab_ladder_select.py measures the two head-to-head)."""
-    b0 = (sel & 1).astype(bool)[..., None]
-    b1 = (sel & 2).astype(bool)[..., None]
-    b2 = (sel & 4).astype(bool)[..., None]
-    b3 = (sel & 8).astype(bool)[..., None]
-    t = [jnp.where(b0, table[i + 1], table[i]) for i in range(0, 16, 2)]
-    t = [jnp.where(b1, t[i + 1], t[i]) for i in range(0, 8, 2)]
-    t = [jnp.where(b2, t[i + 1], t[i]) for i in range(0, 4, 2)]
-    return jnp.where(b3, t[1], t[0])
+# Static nibble tables for GLV half-scalars: 33 MSB-first 4-bit windows of
+# an 11-limb (143-bit) magnitude (bits 132..142 are provably zero).
+_GNIB_POS = np.arange(_GLV_NWIN - 1, -1, -1) * _WINDOW
+_GNIB_LIMB = _GNIB_POS // LIMB_BITS
+_GNIB_OFF = _GNIB_POS % LIMB_BITS
+_GNIB_HI = np.minimum(_GNIB_LIMB + 1, _GLV_HL - 1)
+_GNIB_NEEDHI = (_GNIB_OFF > LIMB_BITS - _WINDOW).astype(np.int32)
+
+
+def _glv_nibbles_msb(k: jnp.ndarray) -> jnp.ndarray:
+    """4-bit windows of an 11-limb magnitude, MSB first: ``(33,) + batch``."""
+    lo = jnp.take(k, jnp.asarray(_GNIB_LIMB), axis=-1) >> jnp.asarray(
+        _GNIB_OFF.astype(np.int32)
+    )
+    hi = jnp.take(k, jnp.asarray(_GNIB_HI), axis=-1) << jnp.asarray(
+        (LIMB_BITS - _GNIB_OFF).astype(np.int32)
+    )
+    nib = (lo | hi * jnp.asarray(_GNIB_NEEDHI)) & 0xF
+    return jnp.moveaxis(nib, -1, 0)
 
 
 @jax.jit
-def ecmul2_base(
+def _ecmul2_base_shamir(
     k1: jnp.ndarray, k2: jnp.ndarray, qx: jnp.ndarray, qy: jnp.ndarray
 ) -> JacobianPoint:
-    """Windowed double-scalar multiply: ``k1*G + k2*Q`` (Shamir/Straus).
+    """Pre-GLV double-scalar multiply: ``k1*G + k2*Q`` (Shamir/Straus).
 
     4-bit interleaved windows over a 64-step ``lax.scan``: 4 shared
     doublings per step, one *mixed* add from the precomputed fixed-base
     ``d*G`` window table (the shared doublings supply the ``16**j``
     scaling), and one Jacobian add from the per-batch 16-entry Q table.
-    Everything is branch-free and scan-free inside the step body (see
-    fields.is_zero_fast) — the hottest loop of the framework.
 
-    ``k1``/``k2`` are semi-reduced scalars mod N; ``qx``/``qy`` affine
-    field elements.
+    Kept as the A/B baseline for :func:`ecmul2_base` (the GLV ladder) and
+    as an independent oracle in the parity tests — it shares no
+    decomposition code with the GLV path.
     """
-    one = jnp.asarray(FIELD.const(1))
     batch = jnp.broadcast_shapes(k1.shape[:-1], k2.shape[:-1], qx.shape[:-1])
     qx = jnp.broadcast_to(qx, batch + (_L,))
     qy = jnp.broadcast_to(qy, batch + (_L,))
-    q_pt = JacobianPoint(qx, qy, jnp.broadcast_to(one, batch + (_L,)))
-
-    # Per-batch Q table: T[d] = d*Q (Jacobian; T[0] = infinity).
-    q_tab = [point_infinity(batch), q_pt]
-    for d in range(2, 16):
-        q_tab.append(point_add_mixed(q_tab[-1], qx, qy))
-    qtx = jnp.stack([t.x for t in q_tab])  # (16, ..., L)
-    qty = jnp.stack([t.y for t in q_tab])
-    qtz = jnp.stack([t.z for t in q_tab])
+    qtx, qty, qtz = _q_window_table(batch, qx, qy)  # (16, ..., L)
 
     n1 = jnp.broadcast_to(
         _scalar_nibbles_msb(fields.canon(ORDER, k1)), (_NWIN,) + batch
@@ -330,6 +494,77 @@ def ecmul2_base(
         return acc, None
 
     acc, _ = jax.lax.scan(body, point_infinity(batch), (n1, n2))
+    return acc
+
+
+@jax.jit
+def ecmul2_base(
+    k1: jnp.ndarray, k2: jnp.ndarray, qx: jnp.ndarray, qy: jnp.ndarray
+) -> JacobianPoint:
+    """GLV double-scalar multiply: ``k1*G + k2*Q`` in a 33-step ladder.
+
+    Both scalars are lambda-split (:func:`glv_split`) into signed
+    half-scalars, giving FOUR 4-bit digit streams over 129-bit magnitudes:
+    ``k1*G = s11*|a|*G + s12*|b|*phi(G)`` and likewise for ``Q``.  Each
+    scan step does 4 shared doublings + 2 mixed adds from fixed tables
+    (``d*G``, ``d*phi(G)``) + 2 Jacobian adds from the per-batch Q table
+    (phi(Q) entries reuse the Q table with x scaled by BETA — phi commutes
+    with scalar multiplication).  Signs are applied at gather time by
+    selecting the negated-y variant, so tables are built once.  Net: 132
+    sequential doublings instead of the Shamir ladder's 256, the single
+    biggest sequential-depth cut available to this curve (this is the
+    hottest loop of the framework — the per-message ``Verifier`` work of
+    reference messages/messages.go:183-198 rides entirely on it).
+
+    ``k1``/``k2`` are semi-reduced scalars mod N; ``qx``/``qy`` affine
+    field elements.
+    """
+    batch = jnp.broadcast_shapes(k1.shape[:-1], k2.shape[:-1], qx.shape[:-1])
+    qx = jnp.broadcast_to(qx, batch + (_L,))
+    qy = jnp.broadcast_to(qy, batch + (_L,))
+    qtx, qty, qtz = _q_window_table(batch, qx, qy)  # (16, ..., L)
+    # phi(Q) table: x scaled by BETA across the table axis (one batched mul).
+    qptx = fields.mul(FIELD, qtx, jnp.asarray(FIELD.const(_BETA)))
+
+    a1, s1, a2, s2 = glv_split(fields.canon(ORDER, k1))  # G half-scalars
+    b1, t1, b2, t2 = glv_split(fields.canon(ORDER, k2))  # Q half-scalars
+    d_g = jnp.broadcast_to(_glv_nibbles_msb(a1), (_GLV_NWIN,) + batch)
+    d_gp = jnp.broadcast_to(_glv_nibbles_msb(a2), (_GLV_NWIN,) + batch)
+    d_q = jnp.broadcast_to(_glv_nibbles_msb(b1), (_GLV_NWIN,) + batch)
+    d_qp = jnp.broadcast_to(_glv_nibbles_msb(b2), (_GLV_NWIN,) + batch)
+
+    g_x, g_y, g_ny = (
+        jnp.asarray(_G_TAB_X),
+        jnp.asarray(_G_TAB_Y),
+        jnp.asarray(_G_TAB_NY),
+    )
+    gp_x = jnp.asarray(_GP_TAB_X)
+    s1b, s2b = s1[..., None], s2[..., None]
+
+    def fixed_term(acc, digit, tab_x, neg):
+        """Mixed add of ``digit * table-point`` with gather-time y negation."""
+        y = jnp.where(neg, _one_hot_select(digit, g_ny), _one_hot_select(digit, g_y))
+        with_g = point_add_mixed(acc, _one_hot_select(digit, tab_x), y)
+        return _sel_pt(digit == 0, acc, with_g)
+
+    def q_term(acc, digit, tab_x, neg):
+        """Jacobian add from the per-batch table (T[0]=inf is complete)."""
+        y = _one_hot_select(digit, qty)
+        y = fields.select(neg, fields.sub(FIELD, jnp.zeros_like(y), y), y)
+        addq = JacobianPoint(_one_hot_select(digit, tab_x), y, _one_hot_select(digit, qtz))
+        return point_add(acc, addq)
+
+    def body(acc, inp):
+        dg, dgp, dq, dqp = inp
+        # 4 shared doublings (doubling infinity is safe: Z stays 0)
+        acc = point_double(point_double(point_double(point_double(acc))))
+        acc = fixed_term(acc, dg, g_x, s1b)
+        acc = fixed_term(acc, dgp, gp_x, s2b)
+        acc = q_term(acc, dq, qtx, t1)
+        acc = q_term(acc, dqp, qptx, t2)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, point_infinity(batch), (d_g, d_gp, d_q, d_qp))
     return acc
 
 

@@ -1,0 +1,84 @@
+"""A/B: XLA-scan keccak-f vs the Pallas (50, B) register-native kernel.
+
+Decides the Pallas kernel's fate with data (VERDICT r03 weak #4): run on a
+live TPU backend and compare p50s at consensus-relevant batch sizes.  On
+CPU the Pallas kernel runs in interpret mode — those numbers say nothing
+about TPU; the script labels the platform on every line.
+
+Usage: python scripts/ab_keccak.py [--sizes 100,200,1000] [--reps 30]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+# The baseline arm times keccak_f's XLA-scan path; with GO_IBFT_PALLAS
+# exported (the very flag under evaluation) keccak_f would route BOTH arms
+# to the Pallas kernel and the A/B would compare it against itself.
+os.environ.pop("GO_IBFT_PALLAS", None)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="100,200,1000")
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--cpu", action="store_true", help="pin CPU (interpret mode)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from go_ibft_tpu.ops.keccak import keccak_f
+    from go_ibft_tpu.ops.pallas_keccak import keccak_f_pallas, pallas_supported
+
+    platform = jax.devices()[0].platform
+    interpret = not pallas_supported()
+
+    def log(**kw):
+        print(json.dumps(kw), flush=True)
+
+    log(platform=platform, pallas_interpret=interpret)
+
+    def med(fn, *xs):
+        jax.block_until_ready(fn(*xs))
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*xs))
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return round(statistics.median(ts), 4)
+
+    xla = jax.jit(keccak_f)
+    pal = jax.jit(lambda st: keccak_f_pallas(st, interpret=interpret))
+
+    rng = np.random.default_rng(7)
+    for b in (int(s) for s in args.sizes.split(",")):
+        state = jnp.asarray(
+            rng.integers(0, 2**32, (b, 25, 2), dtype=np.uint32)
+        )
+        x = med(xla, state)
+        p = med(pal, state)
+        # parity gate: same permutation
+        assert (np.asarray(xla(state)) == np.asarray(pal(state))).all()
+        log(batch=b, xla_scan_ms=x, pallas_ms=p, speedup=round(x / p, 2))
+
+
+if __name__ == "__main__":
+    main()

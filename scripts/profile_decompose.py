@@ -103,6 +103,13 @@ def main() -> None:
     qx = jnp.broadcast_to(jnp.asarray(sec.FIELD.const(sec.GX)), (B, 20))
     qy = jnp.broadcast_to(jnp.asarray(sec.FIELD.const(sec.GY)), (B, 20))
     log(stage="ecmul2_base_ms", p50=med(jax.jit(sec.ecmul2_base), pr, ps, qx, qy))
+    # A/B: the pre-GLV Shamir ladder (64 steps, 2 streams) vs the GLV
+    # ladder above (33 steps, 4 streams) — the r04 headline lever.
+    log(
+        stage="ecmul2_shamir_ms",
+        p50=med(jax.jit(sec._ecmul2_base_shamir), pr, ps, qx, qy),
+    )
+    log(stage="glv_split_ms", p50=med(jax.jit(sec.glv_split), pr))
 
     log(stage="ecdsa_recover_ms", p50=med(jax.jit(sec.ecdsa_recover), z, pr, ps, pv))
 

@@ -203,3 +203,68 @@ def test_host_sign_verify_negative():
     assert host.verify(x, y, d, r, s)
     assert not host.verify(x, y, keccak256(b"other"), r, s)
     assert not host.verify(x, y, d, (r + 1) % host.N, s)
+
+
+# -- GLV decomposition + ladder (round 4) ------------------------------------
+
+
+def test_glv_constants():
+    """The endomorphism constants satisfy their defining identities, and
+    (LAMBDA, BETA) is the matched pair: phi(G) = (BETA*Gx, Gy) equals
+    LAMBDA*G on the curve (a swapped pair — LAMBDA vs LAMBDA^2 — passes
+    the cube-root identities but breaks this)."""
+    assert pow(sec._LAMBDA, 3, sec.N) == 1 and sec._LAMBDA != 1
+    assert pow(sec._BETA, 3, sec.P) == 1 and sec._BETA != 1
+    assert (sec._GLV_A1 + sec._GLV_B1 * sec._LAMBDA) % sec.N == 0
+    assert (sec._GLV_A2 + sec._GLV_B2 * sec._LAMBDA) % sec.N == 0
+    lam_g = host.scalar_mul(sec._LAMBDA, (host.GX, host.GY))
+    assert lam_g == ((sec._BETA * host.GX) % sec.P, host.GY)
+
+
+def test_glv_split_parity():
+    """Device decomposition == the exact host rounding formula, and the
+    recomposition identity k == k1 + k2*LAMBDA (mod N) holds with the
+    half-scalars under 2**129."""
+    rng = np.random.default_rng(11)
+    ks = [int.from_bytes(rng.bytes(32), "big") % sec.N for _ in range(6)]
+    ks += [1, sec.N - 1, sec._LAMBDA, (sec.N - sec._LAMBDA) % sec.N]
+    a1, n1, a2, n2 = sec.glv_split(pack(ks))
+    a1v, a2v = fields.from_limbs(a1), fields.from_limbs(a2)
+    n1v, n2v = np.asarray(n1), np.asarray(n2)
+    for i, k in enumerate(ks):
+        c1 = (k * sec._GLV_G1 + (1 << 383)) >> 384
+        c2 = (k * sec._GLV_G2 + (1 << 383)) >> 384
+        k1 = k - c1 * sec._GLV_A1 - c2 * sec._GLV_A2
+        k2 = -c1 * sec._GLV_B1 - c2 * sec._GLV_B2
+        got1 = -a1v[i] if n1v[i] else a1v[i]
+        got2 = -a2v[i] if n2v[i] else a2v[i]
+        assert (got1, got2) == (k1, k2), hex(k)
+        assert (got1 + got2 * sec._LAMBDA) % sec.N == k
+        assert abs(got1) < 1 << 129 and abs(got2) < 1 << 129
+
+
+def test_glv_ladder_matches_shamir_oracle(points):
+    """The GLV ladder and the pre-GLV Shamir ladder (independent code
+    paths: no shared decomposition) agree lane-for-lane on random double
+    scalars."""
+    pts, J = points
+    rng = np.random.default_rng(12)
+    k1 = [int.from_bytes(rng.bytes(32), "big") % host.N for _ in range(4)]
+    k2 = [int.from_bytes(rng.bytes(32), "big") % host.N for _ in range(4)]
+    glv = unpack_affine(sec.ecmul2_base(pack(k1), pack(k2), J.x, J.y))
+    shamir = unpack_affine(sec._ecmul2_base_shamir(pack(k1), pack(k2), J.x, J.y))
+    assert glv == shamir
+
+
+def test_glv_ladder_negative_half_scalar_edges(points):
+    """Scalars engineered so one or both half-scalars come out negative
+    (LAMBDA and N-LAMBDA decompose to (0, +-1)-shaped splits) exercise the
+    gather-time point negation."""
+    pts, J = points
+    ks = [sec._LAMBDA, (sec.N - sec._LAMBDA) % sec.N, sec.N - 1, 2]
+    got = unpack_affine(sec.ecmul2_base(pack(ks), pack([0, 0, 0, 0]), J.x, J.y))
+    expected = [host.scalar_mul(k, (host.GX, host.GY)) for k in ks]
+    assert got == expected
+    got_q = unpack_affine(sec.ecmul2_base(pack([0] * 4), pack(ks), J.x, J.y))
+    expected_q = [host.scalar_mul(k, p) for k, p in zip(ks, pts)]
+    assert got_q == expected_q

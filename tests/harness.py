@@ -18,6 +18,7 @@ from go_ibft_tpu.core import IBFT, StateName  # noqa: F401
 from go_ibft_tpu.messages import (
     CommitMessage,
     IbftMessage,
+    MessageStore,
     MessageType,
     PreparedCertificate,
     PrepareMessage,
@@ -207,6 +208,62 @@ class MockBackend:
 
     def id(self):
         return self.node_id
+
+
+class MockMessages(MessageStore):
+    """Function-pointer configurable message store (reference
+    core/mock_test.go:351-420 ``mockMessages``).
+
+    Wraps the real :class:`MessageStore`; any behavior can be stubbed per
+    test by assigning ``<method>_fn`` — the reference uses this to drive
+    watcher goroutines with canned store contents instead of real inserts.
+    Inject via ``IBFT(..., message_store=MockMessages())``.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.subscribe_fn: Optional[Callable] = None
+        self.unsubscribe_fn: Optional[Callable] = None
+        self.add_message_fn: Optional[Callable] = None
+        self.get_valid_messages_fn: Optional[Callable] = None
+        self.get_extended_rcc_fn: Optional[Callable] = None
+        self.snapshot_view_fn: Optional[Callable] = None
+        self.signal_event_fn: Optional[Callable] = None
+
+    def subscribe(self, details):
+        if self.subscribe_fn is not None:
+            return self.subscribe_fn(details)
+        return super().subscribe(details)
+
+    def unsubscribe(self, sub_id):
+        if self.unsubscribe_fn is not None:
+            return self.unsubscribe_fn(sub_id)
+        return super().unsubscribe(sub_id)
+
+    def add_message(self, message):
+        if self.add_message_fn is not None:
+            return self.add_message_fn(message)
+        return super().add_message(message)
+
+    def get_valid_messages(self, view, message_type, is_valid):
+        if self.get_valid_messages_fn is not None:
+            return self.get_valid_messages_fn(view, message_type, is_valid)
+        return super().get_valid_messages(view, message_type, is_valid)
+
+    def get_extended_rcc(self, height, is_valid_message, is_valid_rcc):
+        if self.get_extended_rcc_fn is not None:
+            return self.get_extended_rcc_fn(height, is_valid_message, is_valid_rcc)
+        return super().get_extended_rcc(height, is_valid_message, is_valid_rcc)
+
+    def snapshot_view(self, view, message_type):
+        if self.snapshot_view_fn is not None:
+            return self.snapshot_view_fn(view, message_type)
+        return super().snapshot_view(view, message_type)
+
+    def signal_event(self, message_type, view):
+        if self.signal_event_fn is not None:
+            return self.signal_event_fn(message_type, view)
+        return super().signal_event(message_type, view)
 
 
 class Node:

@@ -11,6 +11,7 @@ nothing.
 import asyncio
 from dataclasses import dataclass, field
 
+import pytest
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
@@ -63,9 +64,9 @@ class Setup:
 
 
 @st.composite
-def setups(draw) -> Setup:
-    num_nodes = draw(st.integers(min_value=4, max_value=10))
-    desired_height = draw(st.integers(min_value=1, max_value=3))
+def setups(draw, max_nodes: int = 10, min_height: int = 1, max_height: int = 3) -> Setup:
+    num_nodes = draw(st.integers(min_value=4, max_value=max_nodes))
+    desired_height = draw(st.integers(min_value=min_height, max_value=max_height))
     f = max_faulty(num_nodes)
 
     setup = Setup(nodes=num_nodes)
@@ -147,13 +148,7 @@ def _wire_cluster(cluster: Cluster, setup: Setup, height: int) -> None:
         make(idx, node)
 
 
-@settings(
-    max_examples=6,
-    deadline=None,
-    suppress_health_check=[HealthCheck.too_slow],
-)
-@given(setup=setups())
-def test_property_consensus(setup: Setup):
+def _run_property_consensus(setup: "Setup") -> None:
     async def run() -> None:
         cluster = Cluster(setup.nodes)
         cluster.set_base_timeout(0.1)
@@ -192,3 +187,30 @@ def test_property_consensus(setup: Setup):
             cluster.shutdown()
 
     asyncio.run(run())
+
+
+@settings(
+    max_examples=6,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(setup=setups())
+def test_property_consensus(setup: Setup):
+    """Fast tier: the reference property at reduced draw ranges (4-10
+    nodes, 1-3 heights) so every CI run exercises it."""
+    _run_property_consensus(setup)
+
+
+@pytest.mark.slow
+@settings(
+    max_examples=50,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+@given(setup=setups(max_nodes=30, min_height=5, max_height=20))
+def test_property_consensus_deep(setup: Setup):
+    """Slow tier: the reference's full rapid envelope — 4-30 nodes, target
+    heights 5-20, 50 examples (reference core/rapid_test.go:153-202 draws
+    numNodes in [4, 30] and desiredHeight in [5, 20]).  The interesting
+    RCC/PC interleavings only appear at larger n."""
+    _run_property_consensus(setup)

@@ -62,6 +62,34 @@ def test_verify_senders_masks_agree(cluster_keys):
     assert np.array_equal(hm, dm)
 
 
+def test_verify_senders_oversize_payload_host_digest(cluster_keys):
+    """A payload above the largest keccak block bucket (e.g. a PREPREPARE
+    whose proposal/RCC runs to several KB) must verify, not crash the
+    packer: its digest is computed by the host keccak and injected into
+    the device batch; the ladder still runs on device (r05 fix — a
+    57-block PREPREPARE raised ValueError through ingress and stalled a
+    live cluster)."""
+    from go_ibft_tpu.verify.batch import DeviceBatchVerifier as DBV
+
+    keys, powers, backends = cluster_keys
+    view = View(height=5, round=0)
+    big_raw = bytes(range(256)) * 30  # 7680B payload >> 32-block bucket max
+    msgs = [b.build_prepare_message(b"\x11" * 32, view) for b in backends[:2]]
+    msgs.append(backends[2].build_preprepare_message(big_raw, None, view))
+    assert (
+        len(msgs[-1].encode(include_signature=False)) > DBV._MAX_DEVICE_PAYLOAD
+    )
+    tampered = backends[3].build_preprepare_message(big_raw, None, view)
+    tampered.preprepare_data.proposal.raw_proposal = big_raw[:-1] + b"\x00"
+    msgs.append(tampered)  # oversize AND mutated post-sign -> must fail
+
+    host, device = _verifiers(powers)
+    hm = host.verify_senders(msgs)
+    dm = device.verify_senders(msgs)
+    assert list(hm) == [True, True, True, False]
+    assert np.array_equal(hm, dm)
+
+
 def test_verify_senders_mixed_heights(cluster_keys):
     keys, powers, backends = cluster_keys
     msgs = [

@@ -110,11 +110,13 @@ async def test_real_crypto_multiple_heights():
 
 
 async def test_fused_accept_sets_match_host_path():
-    """The fused device path (_handle_prepare_fused / _drain_valid_commits_fused:
-    ONE quorum_certify/seal_quorum_certify-shaped dispatch per phase) must
-    leave the engine in the SAME observable state as the host path — same
-    surviving store messages, same phase verdicts, same committed seals
-    (VERDICT r1 item #5; reference seam core/ibft.go:855-889,931-967)."""
+    """A device-verifier engine must leave the SAME observable state as a
+    host-verifier engine — same surviving store messages, same phase
+    verdicts, same committed seals (VERDICT r1 item #5; reference seam
+    core/ibft.go:855-889,931-967).  Since r05 the phases themselves are
+    crypto-free (envelopes verified once at ingress, seals once at first
+    sight via the engine's verdict cache); the differential now exercises
+    ingress + seal-batch routes on both verifiers."""
     from go_ibft_tpu.crypto import keccak256
     from go_ibft_tpu.crypto import ecdsa as ec
     from go_ibft_tpu.crypto.backend import encode_signature
@@ -183,8 +185,6 @@ async def test_fused_accept_sets_match_host_path():
 
     host_engine = build_engine(HostBatchVerifier(src))
     fused_engine = build_engine(DeviceBatchVerifier(src))
-    assert fused_engine._fused_for(1)
-    assert not host_engine._fused_for(1)
 
     for phase in ("prepare", "commit"):
         handler = "_handle_" + phase

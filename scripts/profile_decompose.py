@@ -11,23 +11,17 @@ Usage: python scripts/profile_decompose.py [--lanes N]
 import argparse
 import json
 import statistics
-import subprocess
 import sys
 import time
 
+sys.path.insert(0, ".")
 
-def _probe_backend(timeout_s: int = 120) -> bool:
-    probe = "import jax; jax.devices(); print('OK')"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return "OK" in out.stdout
+
+def _probe_backend() -> bool:
+    from go_ibft_tpu.utils.probe import probe_default_backend
+
+    platform, _ = probe_default_backend()
+    return platform is not None
 
 
 def med(fn, *args, reps: int = 10) -> float:
@@ -93,6 +87,21 @@ def main() -> None:
     a = jnp.asarray(np.random.randint(0, 8191, (B, 20)).astype(np.int32))
     log(stage="field_mul_ms", p50=med(jax.jit(lambda x, y: fields.mul(sec.FIELD, x, y)), a, a))
     log(stage="field_inv_ms", p50=med(jax.jit(lambda x: fields.inv(sec.FIELD, x)), a))
+    # r05 levers: the Montgomery product-tree inverse (one Fermat scan for
+    # the whole batch) and the merged sqrt+inv dual scan.
+    log(stage="batch_inv_ms", p50=med(jax.jit(lambda x: fields.batch_inv(sec.FIELD, x)), a))
+    log(
+        stage="pow_fixed2_ms",
+        p50=med(
+            jax.jit(
+                lambda x, y: fields.pow_fixed2(
+                    sec.FIELD, x, (sec.P + 1) // 4, sec.ORDER, y, sec.N - 2
+                )
+            ),
+            a,
+            a,
+        ),
+    )
 
     digest = jax.jit(quorum.digest_words)
     log(stage="digest_words_ms", p50=med(digest, blocks, counts))

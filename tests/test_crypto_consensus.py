@@ -67,9 +67,11 @@ class CryptoCluster:
         for node in self.nodes:
             node.ingress.submit(message)
 
-    # 120s: a 1-core CI host runs the device kernels on CPU and shares the
-    # core with the collector; 30s flaked under load (see r3 fast-tier runs).
-    async def run_height(self, height: int, timeout: float = 120.0):
+    # 240s: a 1-core CI host runs the device kernels on CPU (one ~0.4s
+    # dispatch per ingress burst) and may share the core with another
+    # compile-heavy process; 120s flaked under contention (r05), 30s under
+    # plain load (r3).
+    async def run_height(self, height: int, timeout: float = 240.0):
         tasks = [
             asyncio.create_task(node.core.run_sequence(height))
             for node in self.nodes

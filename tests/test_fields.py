@@ -143,3 +143,56 @@ def test_chained_ops_stay_semi_reduced():
     arr = np.asarray(out)
     assert arr.min() >= 0 and arr.max() <= 1 << F.LIMB_BITS
     assert F.from_limbs(_ops(p)["canon"](out)) == acc_int
+
+
+@pytest.mark.parametrize("p", MODULI)
+@pytest.mark.parametrize("n_lanes", [1, 2, 7, 8])
+def test_batch_inv_matches_fermat(p, n_lanes):
+    """Montgomery product-tree inverse == per-lane Fermat, including zero
+    lanes (inv(0) == 0 contract) and non-power-of-two batches."""
+    m = _ops(p)["m"]
+    rng = random.Random(7)
+    vals = [0] + [rng.randrange(p) for _ in range(n_lanes - 1)]
+    vals = vals[:n_lanes]
+    a = jnp.asarray(F.to_limbs(vals, m.nlimbs))
+    out = jax.jit(partial(F.batch_inv, m))(a)
+    got = F.from_limbs(_ops(p)["canon"](out))
+    assert got == [pow(v, p - 2, p) if v else 0 for v in vals]
+
+
+def test_pow_fixed2_matches_two_pow_fixed():
+    """One merged dual-modulus scan == two independent windowed scans."""
+    mp = _ops(P_SECP)["m"]
+    mn = _ops(N_SECP)["m"]
+    rng = random.Random(9)
+    va = [rng.randrange(P_SECP) for _ in range(4)]
+    vb = [rng.randrange(N_SECP) for _ in range(4)]
+    e1 = (P_SECP + 1) // 4
+    e2 = N_SECP - 2
+    a = jnp.asarray(F.to_limbs(va, mp.nlimbs))
+    b = jnp.asarray(F.to_limbs(vb, mn.nlimbs))
+    r1, r2 = jax.jit(
+        lambda x, y: F.pow_fixed2(mp, x, e1, mn, y, e2)
+    )(a, b)
+    assert F.from_limbs(_ops(P_SECP)["canon"](r1)) == [
+        pow(v, e1, P_SECP) for v in va
+    ]
+    assert F.from_limbs(_ops(N_SECP)["canon"](r2)) == [
+        pow(v, e2, N_SECP) for v in vb
+    ]
+
+
+def test_conv_truncated_columns_exact():
+    """The shear conv's truncating mode (out_len < la+lb-1) keeps exact
+    low columns — the GLV mod-2**143 combinations depend on it."""
+    rng = random.Random(11)
+    a_int = [rng.randrange(2**143) for _ in range(5)]
+    b_int = [rng.randrange(2**143) for _ in range(5)]
+    a = jnp.asarray(F.to_limbs(a_int, 11))
+    b = jnp.asarray(F.to_limbs(b_int, 11))
+    out = jax.jit(lambda x, y: F._conv(x, y, 11))(a, b)
+    got = F.from_limbs(np.asarray(F._exact_carry(out)) & F.LIMB_MASK)
+    # _exact_carry drops the final carry out of limb 10; compare mod 2**143
+    assert [g % 2**143 for g in got] == [
+        (x * y) % 2**143 for x, y in zip(a_int, b_int)
+    ]

@@ -704,7 +704,29 @@ def main() -> None:
         (config5_byzantine_mix, 300.0),
     ):
         _guarded(config_fn, failures, reserve_s=reserve)
-    config2_headline()  # headline LAST: drivers read the final JSON line
+    # Headline LAST: drivers read the final JSON line.  Guarded so a
+    # failure (or an exhausted budget) still ends the artifact with an
+    # honest error line instead of a mid-compile kill (BENCH_r04 rc=124).
+    try:
+        if _remaining_s() < 60:
+            raise TimeoutError(
+                f"budget exhausted before headline ({_remaining_s():.0f}s "
+                "left of GO_IBFT_BENCH_BUDGET_S)"
+            )
+        config2_headline()
+    except Exception as err:  # noqa: BLE001
+        _log(
+            {
+                "metric": "bench_error",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "error": (
+                    f"headline failed: {type(err).__name__}: {err}"[:280]
+                ),
+            }
+        )
+        sys.exit(1)
     if failures:  # diagnostics for CI; exit stays 0 — the headline printed
         _log({"metric": "bench_failures", "value": failures})
 

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# One-shot TPU evidence suite (VERDICT r04 asks #1b, #2, #5, #6).
+#
+# Run when the tunneled TPU is live (probe first; a hanging tunnel eats
+# GO_IBFT_PROBE_TIMEOUT once per process).  Order matters:
+#   1. warm the TPU-keyed persistent cache (compiles happen HERE, not
+#      inside timed sections),
+#   2. stage attribution + design A/Bs (profile_decompose, ab_keccak,
+#      ab_ladder_select),
+#   3. the bench matrix last — it records the headline + calibration.
+#
+# Every step appends JSON lines to $OUT (default evidence_tpu.jsonl).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-evidence_tpu.jsonl}"
+
+step() {
+  echo "{\"evidence_step\": \"$1\", \"ts\": $(date +%s)}" | tee -a "$OUT"
+  shift
+  "$@" 2>&1 | tee -a "$OUT"
+}
+
+step warm_kernels   python scripts/warm_kernels.py --sizes 8,100,300,1000
+step profile        python scripts/profile_decompose.py
+step ab_keccak      python scripts/ab_keccak.py
+step ab_ladder      python scripts/ab_ladder_select.py
+step bench          python bench.py
+echo "evidence complete -> $OUT"

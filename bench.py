@@ -13,11 +13,16 @@ Configs (BASELINE.json):
       correctness + p50
 
 Prints one JSON line per config; the HEADLINE line (config #2, the
-``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST.
-When the TPU backend is unavailable the run degrades honestly: the fused
-kernels still execute on CPU under an explicit ``cpu_fallback_*`` smoke
-metric, but the headline key is never printed, the final line is an
-``error`` line, and the process exits nonzero.
+``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST on
+a live TPU.  When the TPU backend is unavailable the run degrades
+honestly but still measures: a ``bench_error`` line (right after the
+platform line) flags that nothing below is TPU perf evidence, every
+config then records a host-routed (scaled where needed) measurement under
+its BASELINE.md metric key, the headline key stays reserved for a live
+chip, and a late re-probe captures ``evidence_tpu.jsonl`` if the tunnel
+woke up mid-run.  Exit code reports CRASHES only: rc 0 means every
+runnable config completed (fallback included); rc != 0 means a config
+raised.
 
 A differential correctness smoke (device masks vs the host crypto oracle,
 including corrupted lanes) runs BEFORE any timing: a wrong kernel can
@@ -39,8 +44,8 @@ REPS = 30
 # Set by main() when the default backend was dead and the run fell back to
 # CPU.  A fallback run performs NO device work at all (VERDICT r04: a
 # degraded CPU compile of the headline program costs minutes and proves
-# nothing): it reports the host-route happy path, explicit skip lines, and
-# an error line, then exits nonzero.
+# nothing): it flags itself with a bench_error line, then records
+# host-routed measurements for every config and exits 0 unless one crashed.
 _FALLBACK = False
 
 # Total wall-clock budget.  The driver that runs `python bench.py` kills it
@@ -96,12 +101,12 @@ def headline_metric(fallback: bool) -> str:
 
     A CPU fallback must NEVER publish the headline key: a dead tunnel once
     shipped a round with a 7.4s CPU number on the headline metric and rc=0,
-    which read as "perf evidence" (BENCH_r03.json).  The fallback smoke
-    keeps the same measurement shape under an explicitly-degraded key;
-    main() follows it with an ``error`` line and a nonzero exit.
+    which read as "perf evidence" (BENCH_r03.json).  The fallback variant
+    keeps the same round shape under an explicitly-degraded key; main()
+    flags the whole run with a ``bench_error`` line either way.
     """
     if fallback:
-        return "cpu_fallback_fused_smoke_p50_100v"
+        return "cpu_fallback_round_verify_p50_100v"
     return "prepare_commit_quorum_verify_p50_100v"
 
 
@@ -201,6 +206,14 @@ def config1_happy_path() -> None:
     4-validator round to the native host path — the device dispatch floor
     is a loss at this size) against a forced sequential HostBatchVerifier
     cluster.
+
+    Measurement discipline (the r05 0.86x was mostly methodology, not
+    engine): BOTH clusters live in one event loop and run their heights
+    INTERLEAVED (adaptive h, host h, adaptive h+1, ...) so scheduler and
+    host-load drift hits both sides equally, and each cluster runs one
+    untimed warmup height first — the old back-to-back ordering charged
+    every process-wide first-use cost (codec caches, native-lib paths,
+    loop plumbing) to whichever cluster ran first.
     """
     import asyncio
 
@@ -215,13 +228,10 @@ def config1_happy_path() -> None:
 
         debug = error = info
 
-    n_heights = 3 if _FALLBACK else 7
+    n_heights = 7
 
-    def run_cluster(verifier_cls) -> float:
-        """Median per-height full-consensus latency over ``n_heights``
-        (a single height is ~±40% noisy on a shared host — r04's reported
-        0.85x regression was half measurement noise)."""
-        keys = [PrivateKey.from_seed(b"bench-c1-%d" % i) for i in range(4)]
+    def build_cluster(verifier_cls, tag: str):
+        keys = [PrivateKey.from_seed(b"bench-c1-%s-%d" % (tag.encode(), i)) for i in range(4)]
         powers = {k.address: 1 for k in keys}
         src = ECDSABackend.static_validators(powers)
         nodes = []
@@ -256,30 +266,37 @@ def config1_happy_path() -> None:
             )
             core.set_base_round_timeout(30.0)
             nodes.append((core, BatchingIngress(core.add_messages)))
+        return nodes
 
-        async def heights() -> list:
-            per_height = []
-            for h in range(1, n_heights + 1):
-                t0 = time.perf_counter()
-                await asyncio.wait_for(
-                    asyncio.gather(*(core.run_sequence(h) for core, _ in nodes)),
-                    60,
-                )
-                per_height.append((time.perf_counter() - t0) * 1e3)
-            return per_height
+    async def run_height(nodes, h: int) -> float:
+        t0 = time.perf_counter()
+        await asyncio.wait_for(
+            asyncio.gather(*(core.run_sequence(h) for core, _ in nodes)), 60
+        )
+        return (time.perf_counter() - t0) * 1e3
 
+    async def interleaved() -> tuple:
+        adaptive = build_cluster(AdaptiveBatchVerifier, "a")
+        host = build_cluster(HostBatchVerifier, "h")
+        per_a: list = []
+        per_h: list = []
         try:
-            elapsed = asyncio.run(heights())
+            await run_height(adaptive, 1)  # untimed warmup heights
+            await run_height(host, 1)
+            for h in range(2, n_heights + 2):
+                per_a.append(await run_height(adaptive, h))
+                per_h.append(await run_height(host, h))
         finally:
-            for core, ingress in nodes:
+            for core, ingress in adaptive + host:
                 ingress.close()
                 core.messages.close()
-        for core, _ in nodes:
-            assert len(core.backend.inserted) == n_heights
-        return statistics.median(elapsed)
+        for core, _ in adaptive + host:
+            assert len(core.backend.inserted) == n_heights + 1
+        return per_a, per_h
 
-    adaptive_ms = run_cluster(AdaptiveBatchVerifier)
-    host_ms = run_cluster(HostBatchVerifier)
+    per_a, per_h = asyncio.run(interleaved())
+    adaptive_ms = statistics.median(per_a)
+    host_ms = statistics.median(per_h)
     _log(
         {
             "metric": config1_happy_path.metric,
@@ -288,6 +305,7 @@ def config1_happy_path() -> None:
             "vs_baseline": round(host_ms / adaptive_ms, 2),
             "baseline": "same cluster, sequential host verifier",
             "baseline_ms": round(host_ms, 2),
+            "interleaved_heights": n_heights,
         }
     )
 
@@ -398,6 +416,200 @@ def config5_byzantine_mix() -> None:
     )
 
 
+def _signed_round(n: int, seed: int = 0, corrupt_frac: float = 0.0):
+    """One signed round's (prepares, seals, phash, src, expected_mask).
+
+    Host-object analogue of ``go_ibft_tpu.bench.build_round_workload`` (which
+    returns packed device arrays): real keys, real ECDSA envelopes + seals,
+    deterministic corruption for the Byzantine variants.  Shared by the
+    host-routed fallback configs and the config #2 baseline denominator.
+    """
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+    from go_ibft_tpu.messages.helpers import CommittedSeal, extract_committed_seal
+    from go_ibft_tpu.messages.wire import Proposal, View
+
+    keys = _keys(n, seed)
+    powers = {k.address: 1 for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=1, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"bench block 1", round=0))
+    prepares = [b.build_prepare_message(phash, view) for b in backends]
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    expected = np.ones(n, dtype=bool)
+    if corrupt_frac:
+        rng = np.random.default_rng(seed)
+        for i in rng.choice(n, size=int(n * corrupt_frac), replace=False):
+            sig = bytearray(prepares[i].signature)
+            sig[5] ^= 0xFF
+            prepares[i].signature = bytes(sig)
+            seal_sig = bytearray(seals[i].signature)
+            seal_sig[5] ^= 0xFF
+            seals[i] = CommittedSeal(
+                signer=seals[i].signer, signature=bytes(seal_sig)
+            )
+            expected[i] = False
+    return prepares, seals, phash, src, expected
+
+
+def _host_scale(full: int, no_native: int) -> int:
+    """Scaled-down size for host-routed fallback configs: the native C++
+    sequential verifier absorbs a few hundred recovers in well under a
+    second; the pure-Python fallback (~90 ms/recover) cannot."""
+    from go_ibft_tpu import native
+
+    return full if native.load() is not None else no_native
+
+
+def config3_host_scaled() -> None:
+    """Config #3 CPU-fallback variant: scaled-down, host-routed.
+
+    Keeps a measured throughput line on the books for every round (the
+    device config never ran on rounds 1-5 — a packing or pipelining
+    regression was invisible without a chip): the verify leg runs the
+    sequential host path over real signed envelopes+seals, and the device
+    PACKING leg (pack_sender_batch/pack_seal_batch — pure host numpy, no
+    dispatch, no compile) is timed alongside so its regressions show up as
+    ``pack_ms`` growth on any backend.
+    """
+    from go_ibft_tpu.verify import HostBatchVerifier
+    from go_ibft_tpu.verify.batch import pack_seal_batch, pack_sender_batch
+
+    n = _host_scale(200, 8)
+    heights = 3
+    prepares, seals, phash, src, _ = _signed_round(n, seed=11)
+    host = HostBatchVerifier(src)
+
+    t0 = time.perf_counter()
+    for _h in range(heights):
+        assert host.verify_senders(prepares).all()
+        assert host.verify_committed_seals(phash, seals, height=1).all()
+    elapsed = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    pack_sender_batch(prepares)
+    pack_seal_batch(phash, seals)
+    pack_ms = (time.perf_counter() - t0) * 1e3
+
+    _log(
+        {
+            "metric": config3_pipelined.metric,
+            "value": round(2 * n * heights / elapsed, 1),
+            "unit": "sig-verifies/sec (host route)",
+            "vs_baseline": None,
+            "variant": f"host-routed scaled ({n}v x {heights}h, CPU fallback)",
+            "pack_ms": round(pack_ms, 2),
+        }
+    )
+
+
+def config4_host_scaled() -> None:
+    """Config #4 CPU-fallback variant: host-oracle BLS aggregate verify.
+
+    The pure-Python pairing is the semantics oracle for the device path;
+    ONE timed aggregate-verify at a scaled validator count keeps a real
+    number on the books (and catches host-aggregation regressions) without
+    compiling the device pairing program on XLA:CPU (hours cold).
+    """
+    from go_ibft_tpu.crypto import bls as hbls
+
+    n = 8
+    keys = [hbls.BLSPrivateKey.from_seed(b"bls-fallback-%d" % i) for i in range(n)]
+    message = (b"bls fallback proposal hash" + b"\x00" * 32)[:32]
+    sigs = [k.sign(message) for k in keys]
+    t0 = time.perf_counter()
+    ok = hbls.aggregate_verify(
+        [k.pubkey for k in keys], message, hbls.aggregate_signatures(sigs)
+    )
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    assert ok, "host BLS aggregate verify failed"
+    _log(
+        {
+            "metric": config4_bls.metric,
+            "value": round(elapsed_ms, 1),
+            "unit": "ms (host oracle)",
+            "vs_baseline": None,
+            "variant": f"host-routed scaled ({n}v, CPU fallback)",
+        }
+    )
+
+
+def config5_host_scaled() -> None:
+    """Config #5 CPU-fallback variant: Byzantine mix through the host path.
+
+    Pins the masking CONTRACT (30% corrupted lanes must mask out, quorum
+    still reached by the valid 70%) and records a p50 — on the sequential
+    host route at a scaled validator count.
+    """
+    from go_ibft_tpu.core.validator_manager import calculate_quorum
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    n = _host_scale(100, 8)
+    prepares, seals, phash, src, expected = _signed_round(
+        n, seed=3, corrupt_frac=0.3
+    )
+    host = HostBatchVerifier(src)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pmask = host.verify_senders(prepares)
+        smask = host.verify_committed_seals(phash, seals, height=1)
+        times.append((time.perf_counter() - t0) * 1e3)
+    assert (pmask == expected).all(), "host prepare mask diverges"
+    assert (smask == expected).all(), "host seal mask diverges"
+    valid_power = int(expected.sum())
+    assert valid_power >= calculate_quorum(n), "valid 70% must still quorum"
+    _log(
+        {
+            "metric": config5_byzantine_mix.metric,
+            "value": round(statistics.median(times), 3),
+            "unit": "ms (host route)",
+            "vs_baseline": None,
+            "variant": f"host-routed scaled ({n}v, 30% corrupt, CPU fallback)",
+            "bad_lanes_masked": int(n - expected.sum()),
+        }
+    )
+
+
+def config2_host_fallback() -> None:
+    """Config #2 CPU-fallback variant: whole-round verify on the host route.
+
+    NEVER publishes the headline key (``headline_metric`` reserves it for a
+    live TPU): this times the same 100-validator PREPARE+COMMIT round
+    through the sequential host verifier under the explicitly-degraded
+    fallback key, so CPU-only rounds still record the round shape without
+    pretending to be device evidence.
+    """
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    n = _host_scale(100, 8)
+    prepares, seals, phash, src, _ = _signed_round(n, seed=2)
+    host = HostBatchVerifier(src)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        assert host.verify_senders(prepares).all()
+        assert host.verify_committed_seals(phash, seals, height=1).all()
+        times.append((time.perf_counter() - t0) * 1e3)
+    _log(
+        {
+            "metric": headline_metric(True),
+            "value": round(statistics.median(times), 3),
+            "unit": "ms (host route)",
+            "vs_baseline": None,
+            "variant": f"host-routed ({n}v, CPU fallback)",
+            "note": (
+                "TPU backend unavailable; CPU host route is NOT the target "
+                "platform for the <2ms/>=30x goal (BASELINE.md config #2)"
+            ),
+        }
+    )
+
+
 def config2_headline() -> None:
     """100-validator fused PREPARE+COMMIT quorum verification (north star).
 
@@ -448,22 +660,9 @@ def config2_headline() -> None:
     # to the pure-Python loop when no compiler exists.
     from go_ibft_tpu.bench.workload import _keys
     from go_ibft_tpu.crypto import keccak256
-    from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
-    from go_ibft_tpu.messages.helpers import extract_committed_seal
-    from go_ibft_tpu.messages.wire import Proposal, View
 
-    keys = _keys(100, 0)
-    powers = {k.address: 1 for k in keys}
-    src = ECDSABackend.static_validators(powers)
-    backends = [ECDSABackend(k, src) for k in keys]
-    view = View(height=1, round=0)
-    phash = proposal_hash_of(Proposal(raw_proposal=b"bench block 1", round=0))
-    prepares = [b.build_prepare_message(phash, view) for b in backends]
-    seals = [
-        extract_committed_seal(b.build_commit_message(phash, view))
-        for b in backends
-    ]
-    table = [k.address for k in keys]
+    prepares, seals, phash, src, _ = _signed_round(100)
+    table = [k.address for k in _keys(100, 0)]
 
     from go_ibft_tpu import native
 
@@ -572,10 +771,12 @@ def _guarded(config_fn, failures: list, reserve_s: float = 0.0) -> None:
     """Secondary configs must not take down the headline: report the
     failure as a JSON line and keep going.  The differential smoke and the
     headline stay immediately fatal — a wrong kernel must never
-    'benchmark'.  The process still exits 0 when the headline printed
-    (drivers record the final JSON line; rc!=0 would discard a valid
-    headline over a secondary hiccup) — CI gates on the ``error`` lines
-    instead (.github/workflows/main.yml tpu-perf).
+    'benchmark'.  Exit-code contract (VERDICT r5 weak #4): rc reports
+    CRASHES, not platform degradation — main() exits 0 when every runnable
+    config completed (even on CPU fallback, which is flagged by the
+    ``bench_error`` line instead) and nonzero iff a config raised; CI
+    additionally gates on ``error`` lines (.github/workflows/main.yml
+    tpu-perf).
 
     ``reserve_s``: wall-clock that must remain AFTER this config for the
     configs behind it (the headline above all); when the budget no longer
@@ -616,6 +817,12 @@ config1_happy_path.metric = "happy_path_4v_height_latency"
 config3_pipelined.metric = "ecdsa_1000v_10h_pipelined_throughput"
 config4_bls.metric = "bls_aggregate_verify_p50_100v"
 config5_byzantine_mix.metric = "byzantine_300v_30pct_prepare_commit_p50"
+# Fallback variants report under the same BASELINE.md metric keys (one line
+# per config on EVERY backend), self-labeled via their "variant" field.
+config3_host_scaled.metric = config3_pipelined.metric
+config4_host_scaled.metric = config4_bls.metric
+config5_host_scaled.metric = config5_byzantine_mix.metric
+config2_host_fallback.metric = headline_metric(True)
 
 
 def main() -> None:
@@ -633,36 +840,18 @@ def main() -> None:
     _log({"metric": "bench_platform", "value": platform})
 
     if _FALLBACK:
-        # Honest-failure fast path: NO device work of any kind.  r04 died
-        # at rc=124 cold-compiling the 100-lane certify program on XLA:CPU
-        # for a headline it had already decided to flag degraded — the
-        # error line never printed and the round shipped no evidence.  The
-        # only numbers a fallback can honestly contribute are the host-route
-        # cluster latency (config #1 routes 4 validators to the native host
-        # verifier — no jit involved) and explicit skip/error lines.
-        failures: list = []
-        _guarded(config1_happy_path, failures, reserve_s=30.0)
-        for skipped in (
-            config3_pipelined,
-            config4_bls,
-            config5_byzantine_mix,
-        ):
-            _log(
-                {
-                    "metric": skipped.metric,
-                    "value": None,
-                    "unit": None,
-                    "vs_baseline": None,
-                    "note": "skipped on CPU fallback (TPU backend unavailable)",
-                }
-            )
+        # Honest-degraded path: NO device work of any kind (r04 died at
+        # rc=124 cold-compiling the 100-lane certify program on XLA:CPU for
+        # a headline it had already decided to flag degraded), but every
+        # BASELINE.md config still records a MEASURED host-route number —
+        # rounds 1-5 never saw configs #3-#5 complete on any backend, so
+        # packing/pipelining regressions were invisible without a chip.
+        # The bench_error line (up front, right after the platform) flags
+        # that none of it is TPU perf evidence; rc reports crashes only.
         if platform.startswith("cpu (fallback"):
             reason = "TPU backend unavailable (single probe, see backend_probe line)"
         else:
             reason = f"default JAX backend is {platform!r} — not a TPU"
-        # Final parsed line = the error: nonzero rc + an "error" line (the
-        # CI gate greps for it) make the degradation impossible to mistake
-        # for a result.
         _log(
             {
                 "metric": "bench_error",
@@ -670,12 +859,43 @@ def main() -> None:
                 "unit": None,
                 "vs_baseline": None,
                 "error": (
-                    f"{reason}; no headline measurement (host-route lines "
-                    "above are not TPU perf evidence)"
+                    f"{reason}; host-route lines below are real measurements "
+                    "but NOT TPU perf evidence (headline key reserved)"
                 ),
             }
         )
-        sys.exit(1)
+        failures = []
+        for config_fn, reserve in (
+            (config3_host_scaled, 150.0),
+            (config4_host_scaled, 100.0),
+            (config5_host_scaled, 70.0),
+            (config2_host_fallback, 45.0),
+        ):
+            _guarded(config_fn, failures, reserve_s=reserve)
+        # Opportunistic TPU evidence: a tunnel that woke up after the
+        # startup probe still yields evidence_tpu.jsonl (fresh subprocess —
+        # THIS process is pinned to CPU).  Runs before config #1 so the
+        # happy-path line, the round's parity acceptance metric, stays the
+        # final parsed line.
+        from go_ibft_tpu.bench.evidence import reprobe_and_capture
+
+        tpu_platform, detail = reprobe_and_capture(
+            _remaining_s() - 45.0, os.path.abspath(__file__)
+        )
+        if tpu_platform is not None:
+            _log(
+                {
+                    "metric": "tpu_reprobe",
+                    "value": tpu_platform,
+                    "evidence": detail,
+                }
+            )
+        else:
+            _log({"metric": "tpu_reprobe", "value": None, "probe_error": detail})
+        _guarded(config1_happy_path, failures, reserve_s=0.0)
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures else 0)
 
     try:
         differential_smoke()
@@ -727,8 +947,9 @@ def main() -> None:
             }
         )
         sys.exit(1)
-    if failures:  # diagnostics for CI; exit stays 0 — the headline printed
+    if failures:  # a config CRASHED: diagnostics line + nonzero rc
         _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1)
 
 
 if __name__ == "__main__":

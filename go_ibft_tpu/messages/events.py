@@ -107,6 +107,20 @@ class Subscription:
         self._closed = True
         self._set_wakeup()
 
+    def drain_pending(self) -> None:
+        """Coalesce queued duplicate wakeups into the drain about to run.
+
+        The engine re-reads the WHOLE store on every wake, so any event
+        queued before the store read is already covered by it — processing
+        it afterwards would re-run the phase drain for nothing.  Safe
+        against lost wakeups: a message that signals after this clear is
+        either already in the store (visible to the imminent re-read) or
+        its signal lands in the emptied deque and wakes the next ``wait``.
+        """
+        self._rounds.clear()
+        if not self._closed:
+            self._wakeup.clear()
+
     async def wait(self) -> Optional[int]:
         """Await the next matching event's round; ``None`` after close."""
         while True:
@@ -118,6 +132,10 @@ class Subscription:
             if self._closed:
                 return None
             await self._wakeup.wait()
+            # Re-arm before re-checking: drain_pending may leave the event
+            # set with an empty deque (a cross-thread push races the
+            # clear); without this the loop would spin on a set event.
+            self._wakeup.clear()
 
 
 class EventManager:

@@ -414,30 +414,58 @@ def batch_inv(m: Modulus, a: jnp.ndarray) -> jnp.ndarray:
     that are 0 (mod p) are masked to 1 through the tree and forced back to
     0 on output, preserving the ``inv(0) == 0`` contract (infinity maps to
     (0, 0) in ``to_affine``).  Inputs semi-reduced; outputs semi-reduced.
+
+    Both tree sweeps run as ``lax.scan``s over fixed ``(B/2, L)``-padded
+    levels (valid entries keep a prefix-contiguous layout; pad lanes hold
+    exact ones, which multiply through harmlessly): unrolled, the 2*log2(B)
+    shrinking-shape muls each inline ~270 stablehlo lines — 5.2k lines at
+    the 128-lane bucket — and trace size is compile time on XLA:CPU.
     """
     n = a.shape[0]
     if n == 1:
         return inv(m, a)
     zero = is_zero_fast(m, a)
-    cur = select(zero, jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape), a)
+    base = select(zero, jnp.broadcast_to(jnp.asarray(m.const(1)), a.shape), a)
     if n & (n - 1):  # pad to a power of two with exact ones
         pad = (1 << n.bit_length()) - n
         ones = jnp.broadcast_to(
-            jnp.asarray(m.const(1)), (pad,) + cur.shape[1:]
+            jnp.asarray(m.const(1)), (pad,) + base.shape[1:]
         )
-        cur = jnp.concatenate([cur, ones])
-    levels = [cur]
-    while cur.shape[0] > 1:
-        cur = mul(m, cur[0::2], cur[1::2])
-        levels.append(cur)
-    invs = pow_fixed(m, cur, m.p - 2)  # (1, L) root inverse — the ONE scan
-    for lvl in levels[-2::-1]:
-        # child inverse = parent inverse * sibling: ONE batched mul per
-        # level (siblings swapped pairwise), keeping the down-sweep depth
-        # at log2(B) muls.
-        siblings = jnp.stack([lvl[1::2], lvl[0::2]], axis=1).reshape(lvl.shape)
-        invs = mul(m, jnp.repeat(invs, 2, axis=0), siblings)
-    return select(zero, jnp.zeros_like(a), invs[: a.shape[0]])
+        base = jnp.concatenate([base, ones])
+    np2 = base.shape[0]
+    if np2 == 2:
+        root_inv = pow_fixed(m, mul(m, base[0:1], base[1:2]), m.p - 2)
+        sib = jnp.stack([base[1::2], base[0::2]], axis=1).reshape(base.shape)
+        invs = mul(m, jnp.repeat(root_inv, 2, axis=0), sib)
+        return select(zero, jnp.zeros_like(a), invs[: a.shape[0]])
+
+    half = np2 // 2
+    ones_h = jnp.broadcast_to(jnp.asarray(m.const(1)), (half,) + base.shape[1:])
+
+    def up_body(state, _):
+        nxt = mul(m, state[0::2], state[1::2])  # valid prefix halves
+        nxt = jnp.concatenate([nxt, ones_h[: half - nxt.shape[0]]])
+        return nxt, nxt
+
+    lvl1 = mul(m, base[0::2], base[1::2])  # (half, L), fully valid
+    _, ups = jax.lax.scan(up_body, lvl1, None, length=np2.bit_length() - 2)
+    root = ups[-1][0:1]  # (1, L) product of every lane
+    root_inv = pow_fixed(m, root, m.p - 2)  # the ONE Fermat scan
+
+    def down_body(invs, lvl):
+        expanded = jnp.repeat(invs, 2, axis=0)[:half]
+        sib = jnp.stack([lvl[1::2], lvl[0::2]], axis=1).reshape(lvl.shape)
+        return mul(m, expanded, sib), None
+
+    # Walk the stored levels back down: ups[:-1] reversed, then lvl1.
+    down_levels = jnp.concatenate([ups[:-1][::-1], lvl1[None]])
+    invs0 = jnp.concatenate([root_inv, ones_h[: half - 1]])
+    invs, _ = jax.lax.scan(down_body, invs0, down_levels)
+    # Final level: the padded inputs themselves, at full width.
+    expanded = jnp.repeat(invs, 2, axis=0)[:np2]
+    sib = jnp.stack([base[1::2], base[0::2]], axis=1).reshape(base.shape)
+    out = mul(m, expanded, sib)
+    return select(zero, jnp.zeros_like(a), out[: a.shape[0]])
 
 
 def _exact_carry(z: jnp.ndarray) -> jnp.ndarray:

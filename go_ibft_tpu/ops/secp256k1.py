@@ -215,6 +215,19 @@ def point_add_mixed(
 _WINDOW = 4
 _NWIN = 64  # 256 / 4
 
+
+def _double4(p: JacobianPoint) -> JacobianPoint:
+    """Four successive doublings as a length-4 inner scan.
+
+    Four INLINE ``point_double`` calls instantiate the doubling body four
+    times inside the ladder's scan body — ~7k stablehlo lines that are pure
+    compile time (the XLA:CPU certify budget tracks trace size,
+    docs/PERFORMANCE.md).  A fixed-length inner scan traces it ONCE; the
+    cost is 3 extra While iterations per ladder window (~100 per recover),
+    noise against the ~40 field muls each iteration already runs.
+    """
+    return jax.lax.scan(lambda q, _: (point_double(q), None), p, None, length=4)[0]
+
 # ---------------------------------------------------------------------------
 # GLV endomorphism (secp256k1 has CM discriminant -3): phi(x, y) = (BETA*x, y)
 # acts as scalar multiplication by LAMBDA, where BETA**3 == 1 (mod P) and
@@ -476,7 +489,7 @@ def _ecmul2_base_shamir(
     def body(acc, inp):
         d1, d2 = inp
         # 4 shared doublings (doubling infinity is safe: Z stays 0)
-        acc = point_double(point_double(point_double(point_double(acc))))
+        acc = _double4(acc)
         # G term: mixed add of d1*G from the fixed window table (skip when
         # digit == 0)
         with_g = point_add_mixed(
@@ -566,22 +579,32 @@ def ecmul2_base(
 
     def body(acc, d):
         # 4 doublings of all four accumulator lanes (infinity-safe)
-        acc = point_double(point_double(point_double(point_double(acc))))
+        acc = _double4(acc)
         y = _one_hot_select(d, ty)
         y = fields.select(neg, fields.sub(FIELD, jnp.zeros_like(y), y), y)
         addend = JacobianPoint(_one_hot_select(d, tx), y, _one_hot_select(d, tz))
         return point_add(acc, addend), None
 
     acc, _ = jax.lax.scan(body, point_infinity((4,) + batch), digits)
-    # Combine the four lanes: one batched pair-add + one final add.
-    half = point_add(
-        JacobianPoint(acc.x[:2], acc.y[:2], acc.z[:2]),
-        JacobianPoint(acc.x[2:], acc.y[2:], acc.z[2:]),
-    )
-    return point_add(
-        JacobianPoint(half.x[0], half.y[0], half.z[0]),
-        JacobianPoint(half.x[1], half.y[1], half.z[1]),
-    )
+    # Combine the four lanes with two MORE calls to the SAME ``(4,) + batch``
+    # complete add the ladder body uses, padding spent lanes with infinity.
+    # Nested-jit point ops dedup per input shape: a (2,)+batch pair-add plus
+    # a batch-shaped final add each instantiate their own point_add AND
+    # embedded point_double functions (~13k stablehlo lines — a third of the
+    # fused 8-lane certify program), while two wasted infinity lanes cost a
+    # few VPU ops.  Trace size is compile time on XLA:CPU.
+    def _pad4(pt: JacobianPoint, lanes: slice) -> JacobianPoint:
+        x = pt.x[lanes]
+        pinf = point_infinity((4 - x.shape[0],) + batch)
+        return JacobianPoint(
+            jnp.concatenate([x, pinf.x]),
+            jnp.concatenate([pt.y[lanes], pinf.y]),
+            jnp.concatenate([pt.z[lanes], pinf.z]),
+        )
+
+    half = point_add(_pad4(acc, slice(0, None, 2)), _pad4(acc, slice(1, None, 2)))
+    out = point_add(_pad4(half, slice(0, 1)), _pad4(half, slice(1, 2)))
+    return JacobianPoint(out.x[0], out.y[0], out.z[0])
 
 
 def _in_scalar_range(v: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,141 @@
+"""Compile-budget regression guard: stablehlo line counts of the hot programs.
+
+Trace size IS compile time on XLA:CPU (docs/PERFORMANCE.md): the r04->r05
+rounds cut the 8-lane fused certify cold compile 265s -> 55s almost
+entirely by shrinking the traced program (mul 811 -> 316 lines,
+shear-reshape conv), and this round cut it again (~-31%) by deduplicating
+point-op instantiations.  Those wins regress silently — one refactor that
+unrolls a scan or forks a new shape instantiation quietly re-adds minutes
+of cold compile.  This script LOWERS (never compiles — it stays fast on
+any host) the programs that dominate the cold budget, counts their
+stablehlo lines, and fails when any grows >10% over the checked-in
+snapshot (docs/compile_budget.json).
+
+Usage:
+    python scripts/compile_budget.py            # compare vs snapshot
+    python scripts/compile_budget.py --write    # regenerate the snapshot
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SNAPSHOT = pathlib.Path(__file__).resolve().parent.parent / "docs" / "compile_budget.json"
+GROWTH_LIMIT = 0.10
+
+
+def _programs() -> dict:
+    """Lower each budget-tracked program at its engine-hot shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_ibft_tpu.ops import quorum, secp256k1 as sec
+
+    L = sec.FIELD.nlimbs
+    B = 8  # the engine-route lane bucket (the acceptance-tracked compile)
+    blocks = jnp.zeros((B, 2, 17, 2), jnp.uint32)
+    counts = jnp.ones((B,), jnp.int32)
+    limbs = jnp.zeros((B, L), jnp.int32)
+    v = jnp.zeros((B,), jnp.int32)
+    addr = jnp.zeros((B, 5), jnp.uint32)
+    table = jnp.zeros((8, 5), jnp.uint32)
+    live = jnp.zeros((B,), bool)
+    power = jnp.zeros((8,), jnp.int32)
+    hash_zw = jnp.zeros((B, 8), jnp.uint32)
+    thr = jnp.int32(1)
+
+    def lines(fn, *args) -> int:
+        return len(jax.jit(fn).lower(*args).as_text().splitlines())
+
+    return {
+        "quorum_certify_8l": lines(
+            quorum.quorum_certify,
+            blocks, counts, limbs, limbs, v, addr, table, live, power, power,
+            thr, thr,
+        ),
+        "round_certify_8l": lines(
+            quorum.round_certify,
+            blocks, counts, limbs, limbs, v, addr, live,
+            hash_zw, limbs, limbs, v, addr, live,
+            table, power, power, thr, thr,
+        ),
+        "ecdsa_recover_8l": lines(sec.ecdsa_recover, limbs, limbs, limbs, v),
+        "ecmul2_base_8l": lines(sec.ecmul2_base, limbs, limbs, limbs, limbs),
+    }
+
+
+def main() -> int:
+    import jax
+
+    t0 = time.time()
+    measured = _programs()
+    measured["_trace_seconds"] = round(time.time() - t0, 1)
+    measured["_jax_version"] = jax.__version__
+
+    if "--write" in sys.argv:
+        SNAPSHOT.write_text(json.dumps(measured, indent=1) + "\n")
+        print(json.dumps({"compile_budget": "snapshot written", **measured}))
+        return 0
+
+    snapshot = json.loads(SNAPSHOT.read_text())
+    if snapshot.get("_jax_version") != jax.__version__:
+        # Lowering output is jax-version-sensitive: comparing line counts
+        # across versions yields false positives (blocked PRs on an
+        # unchanged repo) or false negatives (masked growth).  CI pins the
+        # snapshot's jax; a deliberate bump re-baselines with --write.
+        print(
+            json.dumps(
+                {
+                    "compile_budget": "FAIL",
+                    "failures": [
+                        f"snapshot from jax {snapshot.get('_jax_version')} but "
+                        f"running jax {jax.__version__}: line counts are not "
+                        "comparable across lowering versions — pin jax or "
+                        "re-baseline with --write"
+                    ],
+                }
+            )
+        )
+        return 1
+    failures = []
+    for name, lines in measured.items():
+        if name.startswith("_"):
+            continue
+        base = snapshot.get(name)
+        if base is None:
+            failures.append(f"{name}: no snapshot entry (run --write)")
+            continue
+        growth = (lines - base) / base
+        status = "FAIL" if growth > GROWTH_LIMIT else "ok"
+        print(
+            json.dumps(
+                {
+                    "program": name,
+                    "lines": lines,
+                    "snapshot": base,
+                    "growth": round(growth, 4),
+                    "status": status,
+                }
+            )
+        )
+        if growth > GROWTH_LIMIT:
+            failures.append(
+                f"{name}: {lines} lines vs snapshot {base} (+{growth:.1%} > "
+                f"{GROWTH_LIMIT:.0%}) — trace size is cold-compile time; "
+                "shrink the program or consciously re-baseline with --write"
+            )
+    if failures:
+        print(json.dumps({"compile_budget": "FAIL", "failures": failures}))
+        return 1
+    print(json.dumps({"compile_budget": "ok", "trace_seconds": measured["_trace_seconds"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,14 +4,23 @@ The bench is the round's perf evidence pipeline; these pin the rules that
 keep a degraded run from masquerading as a result (VERDICT r03 weak #3):
 
 * the headline metric key is reserved for the intended (TPU) platform —
-  a CPU fallback publishes an explicitly-degraded smoke key instead;
-* a fallback run ends with an ``error`` JSON line and nonzero rc (the CI
-  gate greps for ``"error"``: .github/workflows/main.yml tpu-perf).
+  a CPU fallback publishes an explicitly-degraded key instead;
+* a fallback run carries a ``bench_error`` line flagging that nothing in
+  it is TPU perf evidence, but still MEASURES every BASELINE.md config on
+  the host route and exits 0 when all of them completed — rc != 0 is
+  reserved for configs that actually crashed (VERDICT r5 weak #4);
+* under driver conditions (``python bench.py`` in a fresh subprocess,
+  default env, cold function caches) the 4-validator happy path must not
+  regress vs the sequential host baseline (the r05 0.86x).
 """
 
 import ast
+import json
 import pathlib
+import subprocess
 import sys
+
+import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -24,19 +33,85 @@ def test_headline_key_reserved_for_target_platform():
     assert "fallback" in bench.headline_metric(True)
 
 
-def test_fallback_path_exits_nonzero_with_error_line():
-    """Static check: main()'s fallback branch logs an 'error' key and calls
-    sys.exit with a nonzero code.  (Running the real fallback path costs
-    minutes of kernel compiles; the structure is what the contract is.)"""
+def test_fallback_flags_error_but_exits_by_crashes():
+    """Static check: main()'s fallback branch logs a 'bench_error' line
+    (the degradation flag) yet exits 0 when every runnable config
+    completed — nonzero rc is reserved for configs that crashed
+    (VERDICT r5 weak #4)."""
     tree = ast.parse(pathlib.Path(bench.__file__).read_text())
     main_fn = next(
         n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "main"
     )
     src = ast.unparse(main_fn)
-    assert "sys.exit(1)" in src
-    assert "'error'" in src or '"error"' in src
-    # the error line + exit are guarded by the fallback flag
+    assert "bench_error" in src
+    assert "sys.exit(1 if failures else 0)" in src
+    # the degradation flag + crash-driven exit are guarded by the fallback flag
     assert "_FALLBACK" in src
+
+
+_FIVE_CONFIG_KEYS = (
+    "happy_path_4v_height_latency",
+    "ecdsa_1000v_10h_pipelined_throughput",
+    "bls_aggregate_verify_p50_100v",
+    "byzantine_300v_30pct_prepare_commit_p50",
+    bench.headline_metric(True),
+)
+
+
+@pytest.fixture(scope="module")
+def driver_run():
+    """ONE driver-conditions bench run shared by the contract asserts:
+    fresh subprocess, cold function caches — what the round driver
+    executes.  The CPU backend is pinned explicitly: these asserts pin the
+    FALLBACK contract (the acceptance text says "on the CPU backend"), and
+    on a host with a live TPU an unpinned run would take the non-fallback
+    path — minutes of cold device compiles and a different line set."""
+    import os
+
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=pathlib.Path(bench.__file__).parent,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        # Child budget under the subprocess timeout: bench paces itself
+        # against GO_IBFT_BENCH_BUDGET_S (default 720) and would otherwise
+        # be killed mid-run by the 600s timeout on a host without the
+        # native verifier, losing every diagnostic line.
+        env=dict(
+            os.environ, JAX_PLATFORMS="cpu", GO_IBFT_BENCH_BUDGET_S="480"
+        ),
+    )
+    lines = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    return proc, {line["metric"]: line for line in lines if "metric" in line}
+
+
+def test_driver_conditions_all_configs_measure(driver_run):
+    """Every BASELINE.md config emits a MEASURED metric line on the CPU
+    backend — no 'skipped on CPU fallback' placeholders (rounds 1-5 never
+    saw configs #3-#5 complete on any backend), and rc is 0 because
+    completing on a fallback platform is not a crash."""
+    proc, by_metric = driver_run
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for key in _FIVE_CONFIG_KEYS:
+        line = by_metric.get(key)
+        assert line is not None, f"no metric line for {key}: {proc.stdout}"
+        assert line["value"] is not None, f"null value for {key}: {line}"
+        assert "skipped" not in str(line.get("note", "")), line
+
+
+def test_driver_conditions_happy_path_parity(driver_run):
+    """The parity acceptance metric, pinned under driver conditions: the
+    adaptive engine must at least break even against the forced sequential
+    host cluster (>= 0.95x; r05 recorded 0.86x before the ingress-window
+    and measurement-discipline fixes)."""
+    _, by_metric = driver_run
+    line = by_metric["happy_path_4v_height_latency"]
+    assert line["vs_baseline"] >= 0.95, line
 
 
 def test_probe_retries_use_probe_error_key():

@@ -311,30 +311,56 @@ def config1_happy_path() -> None:
 
 
 def config3_pipelined() -> None:
-    """1000 validators x 10 height-batches, dispatches pipelined."""
-    from go_ibft_tpu.bench import build_round_workload
-    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+    """1000 validators x 10 height-batches through the verify pipeline.
 
-    workloads = [build_round_workload(1000, height=h) for h in (1, 2)]
-    args = [(_prep_args(w), _seal_args(w)) for w in workloads]
+    Host packing rides INSIDE the measured loop — it is real per-height
+    work that the pre-PR-2 version hoisted out entirely, so the config
+    never actually pipelined anything.  The double-buffered
+    ``VerifyPipeline`` packs height N+1 while the device executes height
+    N; a sequential pass (pack -> dispatch -> block per height) over the
+    same signed rounds is timed alongside, and its ratio to the pipelined
+    wall-clock (``pipeline_speedup``) is the overlap evidence on any
+    backend.
+    """
+    from go_ibft_tpu.bench import build_signed_round
+    from go_ibft_tpu.ops.quorum import quorum_certify, seal_quorum_certify
+    from go_ibft_tpu.verify.pipeline import (
+        VerifyPipeline,
+        observe_overlap_efficiency,
+    )
+
+    rounds = [build_signed_round(1000, height=h) for h in (1, 2)]
+
+    def pack(h):
+        w = rounds[h % len(rounds)].pack()
+        return _prep_args(w), _seal_args(w)
+
+    def dispatch(args):
+        pa, sa = args
+        return quorum_certify(*pa), seal_quorum_certify(*sa)
 
     # compile + correctness gate
-    for (pa, sa), w in zip(args, workloads):
-        mask, reached, _, _ = quorum_certify(*pa)
-        smask, sreached, _, _ = seal_quorum_certify(*sa)
+    for h, w in enumerate(rounds):
+        out = dispatch(pack(h))
+        jax.block_until_ready(out)
+        (mask, reached, _, _), (smask, sreached, _, _) = out
         n = w.n_validators
         assert np.asarray(mask)[:n].all() and bool(np.asarray(reached))
         assert np.asarray(smask)[:n].all() and bool(np.asarray(sreached))
 
     heights = 10
     t0 = time.perf_counter()
-    outs = []
-    for i in range(heights):  # async dispatch: queue all, block once
-        pa, sa = args[i % len(args)]
-        outs.append(quorum_certify(*pa))
-        outs.append(seal_quorum_certify(*sa))
-    jax.block_until_ready(outs)
+    for h in range(heights):  # sequential reference: block per height
+        jax.block_until_ready(dispatch(pack(h)))
+    seq_elapsed = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    report = VerifyPipeline(depth=2).run(
+        list(range(heights)), pack, dispatch, readback=jax.block_until_ready
+    )
     elapsed = time.perf_counter() - t0
+    eff = observe_overlap_efficiency(seq_elapsed, elapsed)
+
     verifies = 1000 * 2 * heights
     _log(
         {
@@ -343,6 +369,9 @@ def config3_pipelined() -> None:
             "unit": "sig-verifies/sec/chip",
             "vs_baseline": None,
             "elapsed_s": round(elapsed, 3),
+            "pack_ms": round(report.pack_s * 1e3, 2),
+            "pipeline_speedup": round(seq_elapsed / elapsed, 3),
+            "overlap_efficiency": round(eff, 3),
         }
     )
 
@@ -465,46 +494,135 @@ def _host_scale(full: int, no_native: int) -> int:
     return full if native.load() is not None else no_native
 
 
+def _config3_host_line(n: int, heights: int, reps: int = 5) -> dict:
+    """Measure the host-routed config #3 through the verify pipeline.
+
+    Factored out of :func:`config3_host_scaled` so the fast CI tier can run
+    a small-N smoke through the REAL code path (tests/test_pipeline_overlap
+    .py) without a bench subprocess.  Both legs run per rep, paired:
+
+    * sequential — pack height, then verify height, blocking (no overlap);
+    * pipelined — ``VerifyPipeline`` packs height N+1 on the main thread
+      while a worker thread verifies height N (the native C++ verifier
+      releases the GIL, so the overlap is real, not cosmetic).
+
+    The summed ratio is ``pipeline_speedup``; packing throughput is
+    reported as ``pack_lanes_per_s`` so a packing regression trips the
+    bench contract on any backend.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from go_ibft_tpu import native
+    from go_ibft_tpu.crypto import keccak256
+    from go_ibft_tpu.verify import HostBatchVerifier
+    from go_ibft_tpu.verify.batch import pack_seal_batch, pack_sender_batch
+    from go_ibft_tpu.verify.pipeline import (
+        VerifyPipeline,
+        observe_overlap_efficiency,
+    )
+
+    prepares, seals, phash, src, _ = _signed_round(n, seed=11)
+    host = HostBatchVerifier(src)
+    use_native = native.load() is not None
+    if not use_native:
+        # Pure-Python recovers are ~90 ms each; two passes are evidence
+        # enough without eating the fallback budget.
+        reps = min(reps, 2)
+
+    if use_native:
+        # The verify leg is ONE bulk native call per height (the config #2
+        # baseline's sequential per-message loop, C-hosted): it releases
+        # the GIL for its whole run, so main-thread packing genuinely
+        # overlaps — the honest CPU stand-in for an async device dispatch.
+        # Digesting + marshalling is host PACK work (on device it happens
+        # inside the dispatched program, fed by the packed blocks).
+        table = list(src(1))
+
+        def pack(_h):
+            packed = pack_sender_batch(prepares), pack_seal_batch(phash, seals)
+            digests = [
+                keccak256(m.encode(include_signature=False)) for m in prepares
+            ] + [phash] * len(seals)
+            sigs = [m.signature for m in prepares] + [s.signature for s in seals]
+            claimed = [m.sender for m in prepares] + [s.signer for s in seals]
+            return packed, (digests, sigs, claimed)
+
+        def verify(marshalled):
+            digests, sigs, claimed = marshalled
+            assert native.verify_batch_sequential(
+                digests, sigs, claimed, table
+            ).all()
+
+    else:
+
+        def pack(_h):
+            packed = pack_sender_batch(prepares), pack_seal_batch(phash, seals)
+            return packed, None
+
+        def verify(_marshalled):
+            assert host.verify_senders(prepares).all()
+            assert host.verify_committed_seals(phash, seals, height=1).all()
+
+    # One untimed warmup pass: first-use costs (allocator, code paths)
+    # must not be charged to whichever leg happens to run first.
+    _packed, _marshalled = pack(0)
+    verify(_marshalled)
+
+    seq_total = pipe_total = pack_s_total = 0.0
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pipe = VerifyPipeline(depth=2)
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _h in range(heights):
+                _packed, marshalled = pack(_h)
+                verify(marshalled)
+            seq_total += time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            report = pipe.run(
+                list(range(heights)),
+                pack,
+                dispatch=lambda p: pool.submit(verify, p[1]),
+                readback=lambda fut: fut.result(),
+            )
+            pipe_total += time.perf_counter() - t0
+            pack_s_total += report.pack_s
+
+    eff = observe_overlap_efficiency(seq_total, pipe_total)
+    elapsed = pipe_total / reps
+    lanes_packed = 2 * n * heights * reps
+    return {
+        "metric": config3_pipelined.metric,
+        "value": round(2 * n * heights / elapsed, 1),
+        "unit": "sig-verifies/sec (host route)",
+        "vs_baseline": None,
+        "variant": f"host-routed scaled ({n}v x {heights}h, CPU fallback)",
+        "pack_ms": round(pack_s_total / reps * 1e3, 2),
+        "pack_lanes_per_s": round(lanes_packed / pack_s_total, 1),
+        "pipeline_speedup": round(seq_total / pipe_total, 3),
+        "overlap_efficiency": round(eff, 3),
+        "native_verify": use_native,
+        # Overlap needs parallel hardware: on a 1-CPU host the worker
+        # thread and the packer time-slice one core, so the honest ceiling
+        # for pipeline_speedup is ~1.0 (the contract test gates on this).
+        "cpus": os.cpu_count(),
+    }
+
+
 def config3_host_scaled() -> None:
-    """Config #3 CPU-fallback variant: scaled-down, host-routed.
+    """Config #3 CPU-fallback variant: scaled-down, host-routed, pipelined.
 
     Keeps a measured throughput line on the books for every round (the
     device config never ran on rounds 1-5 — a packing or pipelining
     regression was invisible without a chip): the verify leg runs the
-    sequential host path over real signed envelopes+seals, and the device
-    PACKING leg (pack_sender_batch/pack_seal_batch — pure host numpy, no
-    dispatch, no compile) is timed alongside so its regressions show up as
-    ``pack_ms`` growth on any backend.
+    sequential host path over real signed envelopes+seals in a worker
+    thread while the device PACKING leg (pack_sender_batch/pack_seal_batch
+    — pure host numpy, no dispatch, no compile) runs on the main thread
+    through the same ``VerifyPipeline`` as the device config, so packing
+    regressions show up as ``pack_ms``/``pack_lanes_per_s`` drift and lost
+    overlap shows up as ``pipeline_speedup`` < 1 on any backend.
     """
-    from go_ibft_tpu.verify import HostBatchVerifier
-    from go_ibft_tpu.verify.batch import pack_seal_batch, pack_sender_batch
-
-    n = _host_scale(200, 8)
-    heights = 3
-    prepares, seals, phash, src, _ = _signed_round(n, seed=11)
-    host = HostBatchVerifier(src)
-
-    t0 = time.perf_counter()
-    for _h in range(heights):
-        assert host.verify_senders(prepares).all()
-        assert host.verify_committed_seals(phash, seals, height=1).all()
-    elapsed = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    pack_sender_batch(prepares)
-    pack_seal_batch(phash, seals)
-    pack_ms = (time.perf_counter() - t0) * 1e3
-
-    _log(
-        {
-            "metric": config3_pipelined.metric,
-            "value": round(2 * n * heights / elapsed, 1),
-            "unit": "sig-verifies/sec (host route)",
-            "vs_baseline": None,
-            "variant": f"host-routed scaled ({n}v x {heights}h, CPU fallback)",
-            "pack_ms": round(pack_ms, 2),
-        }
-    )
+    _log(_config3_host_line(_host_scale(200, 8), heights=3))
 
 
 def config4_host_scaled() -> None:

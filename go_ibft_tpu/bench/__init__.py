@@ -1,5 +1,15 @@
 """Benchmark harness: workload builders + timing for BASELINE.md configs."""
 
-from .workload import RoundWorkload, build_round_workload
+from .workload import (
+    RoundWorkload,
+    SignedRound,
+    build_round_workload,
+    build_signed_round,
+)
 
-__all__ = ["RoundWorkload", "build_round_workload"]
+__all__ = [
+    "RoundWorkload",
+    "SignedRound",
+    "build_round_workload",
+    "build_signed_round",
+]

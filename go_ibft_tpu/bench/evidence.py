@@ -9,6 +9,11 @@ subprocess — this process pinned ``jax_platforms=cpu`` at startup and can
 never use the chip itself — appending the child's JSON lines to
 ``evidence_tpu.jsonl`` (the same artifact ``scripts/tpu_evidence.sh``
 builds).
+
+The child emits the same line schema as the parent, so first-class
+packing/pipelining attribution (``pack_ms``, ``pack_lanes_per_s``,
+``pipeline_speedup``, ``overlap_efficiency`` on the config #3 line — CPU
+and TPU variants alike) is captured here without any extra plumbing.
 """
 
 from __future__ import annotations

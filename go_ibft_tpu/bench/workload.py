@@ -40,6 +40,46 @@ def _keys(n: int, seed: int) -> list:
 
 
 @dataclass
+class SignedRound:
+    """One round's raw signed material, BEFORE device packing.
+
+    The unpacked twin of :class:`RoundWorkload`: the pipelined benchmarks
+    pack these per height *inside* the dispatch pipeline (packing is part
+    of what they measure/overlap), while :func:`build_round_workload`
+    packs eagerly for callers that only time the kernels.
+    """
+
+    n_validators: int
+    height: int
+    prepares: list
+    seals: list
+    proposal_hash: bytes
+    table: np.ndarray  # (V, 5) uint32
+    powers_lo: np.ndarray
+    powers_hi: np.ndarray
+    thr_lo: int
+    thr_hi: int
+    expected_prepare_mask: np.ndarray
+    expected_seal_mask: np.ndarray
+
+    def pack(self, pad_lanes: int = 0) -> "RoundWorkload":
+        """Pack PREPARE envelopes + COMMIT seals to device-ready arrays."""
+        return RoundWorkload(
+            n_validators=self.n_validators,
+            height=self.height,
+            prepare=pack_sender_batch(self.prepares, pad_lanes),
+            seals=pack_seal_batch(self.proposal_hash, self.seals, pad_lanes),
+            table=self.table,
+            powers_lo=self.powers_lo,
+            powers_hi=self.powers_hi,
+            thr_lo=self.thr_lo,
+            thr_hi=self.thr_hi,
+            expected_prepare_mask=self.expected_prepare_mask,
+            expected_seal_mask=self.expected_seal_mask,
+        )
+
+
+@dataclass
 class RoundWorkload:
     """Device-ready arrays for one round's PREPARE + COMMIT phases."""
 
@@ -58,14 +98,15 @@ class RoundWorkload:
     expected_seal_mask: np.ndarray
 
 
-def build_round_workload(
+def build_signed_round(
     n_validators: int,
     *,
     height: int = 1,
     corrupt_frac: float = 0.0,
     seed: int = 0,
-    pad_lanes: int = 0,
-) -> RoundWorkload:
+) -> SignedRound:
+    """Build one signed (unpacked) round: real keys, real ECDSA envelopes
+    and seals, deterministic corruption for the Byzantine variants."""
     keys = _keys(n_validators, seed)
     powers = {k.address: 1 for k in keys}
     src = ECDSABackend.static_validators(powers)
@@ -104,11 +145,12 @@ def build_round_workload(
     threshold = (2 * total) // 3 + 1
     thr_lo, thr_hi = threshold & 0xFFFF, threshold >> 16
 
-    return RoundWorkload(
+    return SignedRound(
         n_validators=n_validators,
         height=height,
-        prepare=pack_sender_batch(prepares, pad_lanes),
-        seals=pack_seal_batch(phash, seals, pad_lanes),
+        prepares=prepares,
+        seals=seals,
+        proposal_hash=phash,
         table=table,
         powers_lo=powers_lo,
         powers_hi=powers_hi,
@@ -117,3 +159,16 @@ def build_round_workload(
         expected_prepare_mask=expected_prepare,
         expected_seal_mask=expected_seal,
     )
+
+
+def build_round_workload(
+    n_validators: int,
+    *,
+    height: int = 1,
+    corrupt_frac: float = 0.0,
+    seed: int = 0,
+    pad_lanes: int = 0,
+) -> RoundWorkload:
+    return build_signed_round(
+        n_validators, height=height, corrupt_frac=corrupt_frac, seed=seed
+    ).pack(pad_lanes)

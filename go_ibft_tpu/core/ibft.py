@@ -178,6 +178,13 @@ class IBFT:
         self._seal_verdicts.clear()
         self._seal_verdict_count = 0
         self._hash_memo.clear()
+        # New sequence: drop the verifier's per-message pack cache (same
+        # lifecycle as the seal-verdict cache) and tag round 0.
+        bv = self.batch_verifier
+        if hasattr(bv, "reset_pack_cache"):
+            bv.reset_pack_cache()
+        if hasattr(bv, "note_round"):
+            bv.note_round(0)
 
         try:
             self.validator_manager.init(height)
@@ -1080,6 +1087,10 @@ class IBFT:
     def _move_to_new_round(self, round_: int) -> None:
         """(reference core/ibft.go:994-1003)"""
         self._hash_memo.clear()
+        # Round advance drives the pack cache's oldest-round-first eviction
+        # (entries packed for dead rounds yield before the live round's).
+        if hasattr(self.batch_verifier, "note_round"):
+            self.batch_verifier.note_round(round_)
         self.state.set_view(View(height=self.state.height, round=round_))
         self.state.set_round_started(False)
         self.state.set_proposal_message(None)

@@ -99,3 +99,16 @@ def keccak256(data: bytes) -> bytes:
     if _native_impl is not None:
         return _native_impl(data)
     return _keccak256_py(data)
+
+
+def keccak256_many(items: List[bytes]) -> List[bytes]:
+    """Bulk host digests: one impl lookup for the whole batch.
+
+    Used by the packing edge for lanes whose digest must come from the host
+    (oversize payloads past the largest device block bucket): the per-call
+    global lookup and function-call overhead is paid once per batch instead
+    of once per message.  Semantically identical to ``[keccak256(x) for x
+    in items]``.
+    """
+    impl = _native_impl if _native_impl is not None else _keccak256_py
+    return [impl(data) for data in items]

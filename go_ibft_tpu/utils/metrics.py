@@ -59,6 +59,25 @@ def get_histogram(key: Sequence[str]) -> list[float]:
         return list(_histograms.get(tuple(key), ()))
 
 
+def summarize(key: Sequence[str]) -> Optional[dict]:
+    """Histogram summary ``{count, p50, mean, max}`` or ``None`` if empty.
+
+    First-class evidence hook for the packing/pipelining attribution keys
+    (``verify/pipeline.py::PACK_MS_KEY`` etc.): bench lines and tests read
+    one summary dict instead of re-deriving percentiles from raw samples.
+    """
+    samples = get_histogram(key)
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    return {
+        "count": len(ordered),
+        "p50": ordered[len(ordered) // 2],
+        "mean": sum(ordered) / len(ordered),
+        "max": ordered[-1],
+    }
+
+
 def reset() -> None:
     """Clear all recorded metrics (test support)."""
     with _lock:

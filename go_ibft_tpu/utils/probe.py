@@ -16,14 +16,22 @@ a hard timeout, and the parent decides.  Both ``bench.py`` and
 different knobs (VERDICT r04 weak #7); this module is now the single
 implementation and ``GO_IBFT_PROBE_TIMEOUT`` the single knob.
 
-The timeout default is 120 s with ONE attempt: retries are useless (every
-observed outage is either instant-fail — which the probe reports in
-seconds regardless of the timeout — or hours-long), and a live tunnel
-initializes well under two minutes (r03 measured whole device suites
-within session budgets).  A dead-but-HANGING tunnel costs the timeout
-exactly once per process; callers with their own wall-clock budget clamp
-via ``timeout_s`` (bench.py passes half its remaining budget), everyone
-else shares the single ``GO_IBFT_PROBE_TIMEOUT`` knob.
+The timeout default is 120 s with ONE attempt *per probe point*: blind
+retries in a loop are useless (every observed outage is either
+instant-fail — which the probe reports in seconds regardless of the
+timeout — or hours-long), and a live tunnel initializes well under two
+minutes (r03 measured whole device suites within session budgets).  A
+dead-but-HANGING tunnel costs the timeout exactly once per call; callers
+with their own wall-clock budget clamp via ``timeout_s`` (bench.py passes
+half its remaining budget), everyone else shares the single
+``GO_IBFT_PROBE_TIMEOUT`` knob.
+
+Single-shot does NOT mean a fallback run gives up on the chip: since PR 1
+a CPU-fallback bench re-probes once more near its END
+(``go_ibft_tpu/bench/evidence.py::reprobe_and_capture``) and, when the
+tunnel woke up mid-run, relaunches the bench in a fresh subprocess to
+capture ``evidence_tpu.jsonl`` — two probe points bracketing the run, no
+retry loops in between.
 """
 
 from __future__ import annotations

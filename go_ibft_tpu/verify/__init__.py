@@ -24,10 +24,13 @@ from .batch import (
     HostBatchVerifier,
     SIG_BYTES,
 )
+from .pipeline import PackCache, VerifyPipeline
 
 __all__ = [
     "AdaptiveBatchVerifier",
     "DeviceBatchVerifier",
     "HostBatchVerifier",
+    "PackCache",
+    "VerifyPipeline",
     "SIG_BYTES",
 ]

@@ -29,14 +29,16 @@ import numpy as np
 
 from ..core.validator_manager import calculate_quorum
 from ..crypto import ecdsa as host_ecdsa
-from ..crypto.keccak import keccak256
+from ..crypto.keccak import keccak256, keccak256_many
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import IbftMessage
 from ..ops import fields
 from ..ops import keccak as dk
 from ..ops import quorum
 from ..ops import secp256k1 as sec
+from ..ops.fields import LIMB_BITS, LIMB_MASK
 from ..utils import metrics
+from .pipeline import PackCache, SenderPack, VerifyPipeline
 
 SIG_BYTES = 65  # r(32) || s(32) || v(1)
 
@@ -199,8 +201,58 @@ _round_kernel = jax.jit(_round_fn)
 
 
 def _pack_scalars(values: List[int], pad_to: int) -> jnp.ndarray:
+    """Python-int scalars -> padded limb array (reference packers only; the
+    vectorized path limb-splits straight from signature bytes and never
+    materializes Python ints)."""
     values = values + [0] * (pad_to - len(values))
     return jnp.asarray(fields.to_limbs(values, sec.FIELD.nlimbs))
+
+
+def _split_signatures(
+    sigs: Sequence[bytes],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized :func:`split_signature`: N sigs -> value words + v.
+
+    Returns ``(r_words, s_words, v)`` with the words as ``(N, 8)`` uint32
+    little-endian value words (the 32 big-endian bytes reversed and viewed
+    as uint32) and ``v`` as ``(N,)`` int32.  One C-level join + one
+    ``frombuffer`` for the whole batch; raises on any wrong-length
+    signature, naming the lane.
+    """
+    for i, sig in enumerate(sigs):
+        if len(sig) != SIG_BYTES:
+            raise ValueError(
+                f"signature {i} must be {SIG_BYTES} bytes, got {len(sig)}"
+            )
+    n = len(sigs)
+    if n == 0:
+        z = np.zeros((0, 8), dtype=np.uint32)
+        return z, z.copy(), np.zeros((0,), dtype=np.int32)
+    flat = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, SIG_BYTES)
+    r_words = np.ascontiguousarray(flat[:, 31::-1]).view("<u4")
+    s_words = np.ascontiguousarray(flat[:, 63:31:-1]).view("<u4")
+    return r_words, s_words, flat[:, 64].astype(np.int32)
+
+
+def _words_to_limbs(words: np.ndarray, nlimbs: int) -> np.ndarray:
+    """``(N, nw)`` uint32 LE value words -> ``(N, nlimbs)`` int32 limbs.
+
+    The numpy twin of :func:`go_ibft_tpu.ops.keccak.words_le_to_limbs`
+    (same shift schedule), replacing the per-value Python-int loop of
+    ``fields.to_limbs`` with ``nlimbs`` whole-batch shift/mask ops.
+    """
+    nw = words.shape[-1]
+    out = np.zeros(words.shape[:-1] + (nlimbs,), dtype=np.int32)
+    for k in range(nlimbs):
+        lo_bit = LIMB_BITS * k
+        j, sh = divmod(lo_bit, 32)
+        if j >= nw:
+            break
+        acc = words[..., j] >> np.uint32(sh)
+        if sh + LIMB_BITS > 32 and j + 1 < nw:
+            acc = acc | (words[..., j + 1] << np.uint32(32 - sh))
+        out[..., k] = (acc & np.uint32(LIMB_MASK)).astype(np.int32)
+    return out
 
 
 def pack_validator_table(addresses: Sequence[bytes], bucket: bool = True) -> np.ndarray:
@@ -210,10 +262,8 @@ def pack_validator_table(addresses: Sequence[bytes], bucket: bool = True) -> np.
         raise ValueError("empty validator set")
     v = _bucket(len(addresses), _TABLE_BUCKETS) if bucket else len(addresses)
     table = np.zeros((v, 5), dtype=np.uint32)
-    for i, a in enumerate(addresses):
-        table[i] = dk.address_to_words(a)
-    for i in range(len(addresses), v):
-        table[i] = table[0]  # padding adds no new member
+    table[: len(addresses)] = dk.addresses_to_words(addresses)
+    table[len(addresses) :] = table[0]  # padding adds no new member
     return table
 
 
@@ -221,6 +271,9 @@ def pack_sender_batch(
     msgs: Sequence[IbftMessage],
     pad_lanes: int = 0,
     payloads: Optional[List[bytes]] = None,
+    cache: Optional[PackCache] = None,
+    cache_payloads: Optional[List[bytes]] = None,
+    cache_hits: Optional[List[Optional[SenderPack]]] = None,
 ):
     """Messages -> device-ready arrays for the sender-validity kernel.
 
@@ -229,7 +282,134 @@ def pack_sender_batch(
     malformed messages (wrong sender/signature length).  ``payloads``
     overrides the per-message signed bytes (the oversize-payload path
     substitutes empty payloads for lanes whose digest is computed on host).
+
+    Vectorized end to end: signatures split + limbed straight from bytes in
+    one shot (:func:`_split_signatures` -> :func:`_words_to_limbs`), sender
+    addresses bulk-converted, and the keccak block packing done once for
+    the whole batch (``ops/keccak.py::pack_messages``).  Bit-identical to
+    :func:`_pack_sender_batch_reference` (tests/test_pack_vectorized.py).
+
+    ``cache`` (a :class:`~go_ibft_tpu.verify.pipeline.PackCache`) reuses a
+    message's encoded payload + limb rows from an earlier pack and stores
+    fresh ones; ``cache_payloads`` supplies the TRUE payloads for cache
+    stores when ``payloads`` carries substituted (oversize-lane) bytes —
+    without it, an explicit ``payloads`` disables stores so a substituted
+    payload can never poison the cache.  ``cache_hits`` passes lookups a
+    caller already performed (``_sender_inputs`` needs them for payload
+    sizing) so the hot path pays one lock-guarded lookup per message, not
+    two.
+
+    Empty input returns a fully-dead padded batch (all ``live`` False,
+    smallest block bucket) instead of raising — an empty drain is a no-op,
+    not a crash.
     """
+    n = len(msgs)
+    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    nl = sec.FIELD.nlimbs
+    r_limbs = np.zeros((bb, nl), dtype=np.int32)
+    s_limbs = np.zeros((bb, nl), dtype=np.int32)
+    v = np.zeros((bb,), dtype=np.int32)
+    senders = np.zeros((bb, 5), dtype=np.uint32)
+    live = np.zeros((bb,), dtype=bool)
+    if n == 0:
+        blocks = np.zeros((bb, _BLOCK_BUCKETS[0], 17, 2), dtype=np.uint32)
+        return blocks, np.ones((bb,), np.int32), r_limbs, s_limbs, v, senders, live
+
+    if cache_hits is not None:
+        hits: List[Optional[SenderPack]] = cache_hits
+    elif cache is not None:
+        hits = [cache.lookup(m) for m in msgs]
+    else:
+        hits = [None] * n
+    own_payloads = payloads is None
+    if own_payloads:
+        payloads = [
+            h.payload if h is not None else m.encode(include_signature=False)
+            for h, m in zip(hits, msgs)
+        ]
+        cache_payloads = payloads
+
+    max_len = max(len(p) for p in payloads)
+    nb = _bucket((max_len + 1 + dk.RATE_BYTES - 1) // dk.RATE_BYTES, _BLOCK_BUCKETS)
+    blocks = np.zeros((bb, nb, 17, 2), dtype=np.uint32)
+    counts = np.ones((bb,), dtype=np.int32)
+    pb, pc = dk.pack_messages(payloads, nb)
+    blocks[:n] = pb
+    counts[:n] = pc
+
+    miss = [i for i, h in enumerate(hits) if h is None]
+    if miss:
+        rw, sw, vv = _split_signatures([msgs[i].signature for i in miss])
+        rl = _words_to_limbs(rw, nl)
+        sl = _words_to_limbs(sw, nl)
+        aw = dk.addresses_to_words([msgs[i].sender for i in miss])
+        idx = np.asarray(miss)
+        r_limbs[idx] = rl
+        s_limbs[idx] = sl
+        v[idx] = vv
+        senders[idx] = aw
+        if cache is not None and cache_payloads is not None:
+            for j, i in enumerate(miss):
+                cache.store(
+                    msgs[i],
+                    SenderPack(
+                        payload=cache_payloads[i],
+                        r_limbs=rl[j].copy(),
+                        s_limbs=sl[j].copy(),
+                        v=int(vv[j]),
+                        sender_words=aw[j].copy(),
+                    ),
+                )
+    for i, h in enumerate(hits):
+        if h is not None:
+            r_limbs[i] = h.r_limbs
+            s_limbs[i] = h.s_limbs
+            v[i] = h.v
+            senders[i] = h.sender_words
+    live[:n] = True
+    return blocks, counts, r_limbs, s_limbs, v, senders, live
+
+
+def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_lanes: int = 0):
+    """Seals -> device-ready arrays for the seal-validity kernel.
+
+    Returns ``(hash_words, r, s, v, signers, live)``; the proposal hash is
+    broadcast to every lane as little-endian value words.  Vectorized like
+    :func:`pack_sender_batch`; an empty seal sequence returns a fully-dead
+    padded batch.
+    """
+    n = len(seals)
+    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
+    hash_zw = np.broadcast_to(hw, (bb, 8)).copy()
+    nl = sec.FIELD.nlimbs
+    r_limbs = np.zeros((bb, nl), dtype=np.int32)
+    s_limbs = np.zeros((bb, nl), dtype=np.int32)
+    v = np.zeros((bb,), dtype=np.int32)
+    signers = np.zeros((bb, 5), dtype=np.uint32)
+    live = np.zeros((bb,), dtype=bool)
+    if n:
+        rw, sw, vv = _split_signatures([s.signature for s in seals])
+        r_limbs[:n] = _words_to_limbs(rw, nl)
+        s_limbs[:n] = _words_to_limbs(sw, nl)
+        v[:n] = vv
+        signers[:n] = dk.addresses_to_words([s.signer for s in seals])
+        live[:n] = True
+    return hash_zw, r_limbs, s_limbs, v, signers, live
+
+
+# -- reference loop packers (parity oracles) ---------------------------------
+# The original per-message implementations, kept verbatim so the vectorized
+# packers above have bit-identity references to diff against
+# (tests/test_pack_vectorized.py); not hot paths.
+
+
+def _pack_sender_batch_reference(
+    msgs: Sequence[IbftMessage],
+    pad_lanes: int = 0,
+    payloads: Optional[List[bytes]] = None,
+):
+    """Per-message loop twin of :func:`pack_sender_batch`."""
     n = len(msgs)
     bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
     if payloads is None:
@@ -238,7 +418,7 @@ def pack_sender_batch(
     nb = _bucket((max_len + 1 + dk.RATE_BYTES - 1) // dk.RATE_BYTES, _BLOCK_BUCKETS)
     blocks = np.zeros((bb, nb, 17, 2), dtype=np.uint32)
     counts = np.ones((bb,), dtype=np.int32)
-    pb, pc = dk.pack_messages(payloads, nb)
+    pb, pc = dk._pack_messages_reference(payloads, nb)
     blocks[:n] = pb
     counts[:n] = pc
     rs, ss, vs = [], [], []
@@ -262,12 +442,10 @@ def pack_sender_batch(
     )
 
 
-def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_lanes: int = 0):
-    """Seals -> device-ready arrays for the seal-validity kernel.
-
-    Returns ``(hash_words, r, s, v, signers, live)``; the proposal hash is
-    broadcast to every lane as little-endian value words.
-    """
+def _pack_seal_batch_reference(
+    proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_lanes: int = 0
+):
+    """Per-message loop twin of :func:`pack_seal_batch`."""
     n = len(seals)
     bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
     hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
@@ -306,10 +484,30 @@ class DeviceBatchVerifier:
         enable_persistent_cache()
         self._validators = validators_for_height
         self._tables: Dict[int, Tuple[np.ndarray, List[bytes]]] = {}
+        # Device-resident twins of the packed tables/power vectors: uploaded
+        # once per height and reused by every dispatch of that height
+        # (re-uploading per call was a host->device copy of data that never
+        # changes within a height).
+        self._tables_dev: Dict[int, jnp.ndarray] = {}
         self._quorum_packs: Dict[
             int, Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, int]]
         ] = {}
+        self._quorum_dev: Dict[int, Tuple[jnp.ndarray, jnp.ndarray]] = {}
         self._cache_heights = cache_heights
+        # Per-message pack cache (round-scoped, like the engine's
+        # seal-verdict cache): engine wakeups that re-verify the same
+        # messages (certificate validation re-runs per wakeup) skip the
+        # re-encode + re-limb entirely.
+        self._pack_cache = PackCache()
+
+    def note_round(self, round_: int) -> None:
+        """Engine hook: tag pack-cache entries with the live round (round
+        advances drive the cache's oldest-round-first eviction)."""
+        self._pack_cache.note_round(round_)
+
+    def reset_pack_cache(self) -> None:
+        """Engine hook: new sequence -> drop all cached packs."""
+        self._pack_cache.clear()
 
     def warmup(
         self,
@@ -369,11 +567,33 @@ class DeviceBatchVerifier:
         table = pack_validator_table(addrs)
         self._tables[height] = (table, addrs)
         if len(self._tables) > self._cache_heights:
-            self._tables.pop(min(self._tables))
+            evicted = min(self._tables)
+            self._tables.pop(evicted)
+            self._tables_dev.pop(evicted, None)
         return table, addrs
 
     def _table(self, height: int) -> np.ndarray:
         return self._table_and_addrs(height)[0]
+
+    def _table_dev(self, height: int) -> jnp.ndarray:
+        """Device-resident packed table (uploaded once per height)."""
+        hit = self._tables_dev.get(height)
+        if hit is None:
+            hit = jnp.asarray(self._table(height))
+            self._tables_dev[height] = hit
+        return hit
+
+    def _quorum_powers_dev(
+        self, height: int, plo: np.ndarray, phi: np.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Device-resident power vectors for the fused-quorum kernels."""
+        hit = self._quorum_dev.get(height)
+        if hit is None:
+            hit = (jnp.asarray(plo), jnp.asarray(phi))
+            self._quorum_dev[height] = hit
+            if len(self._quorum_dev) > self._cache_heights:
+                self._quorum_dev.pop(min(self._quorum_dev))
+        return hit
 
     def _quorum_pack(
         self, height: int
@@ -437,33 +657,51 @@ class DeviceBatchVerifier:
             and len(seal.signature) == SIG_BYTES
         )
 
-    def _dispatch(self, inputs, table, quorum_args, metric: str):
-        """Run the recover (mask-only) or certify (mask+quorum) kernel.
+    def _dispatch_async(self, inputs, table, quorum_args):
+        """Queue the recover (mask-only) or certify (mask+quorum) kernel.
 
         ``inputs`` = (zw, r, s, v, claimed, live) numpy/jax arrays;
-        ``quorum_args`` = None for the plain mask, or (plo, phi, thr)."""
-        t0 = time.perf_counter()
+        ``quorum_args`` = None for the plain mask, or (plo, phi, thr).
+        Returns ``(mask_dev, reached_dev_or_None)`` device futures WITHOUT
+        blocking — JAX async dispatch lets the caller pack the next batch
+        while this one executes (:mod:`go_ibft_tpu.verify.pipeline`).
+        """
         zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
         if quorum_args is None:
-            mask = _recover_kernel(zw, r, s, v, claimed, jnp.asarray(table), live)
-            reached = None
-        else:
-            plo, phi, thr = quorum_args
-            mask, reached_dev, _, _ = _certify_kernel(
-                zw,
-                r,
-                s,
-                v,
-                claimed,
-                jnp.asarray(table),
-                live,
-                jnp.asarray(plo),
-                jnp.asarray(phi),
-                jnp.int32(max(thr, 0) & 0xFFFF),
-                jnp.int32(max(thr, 0) >> 16),
+            return (
+                _recover_kernel(zw, r, s, v, claimed, jnp.asarray(table), live),
+                None,
             )
-            reached = bool(np.asarray(reached_dev))
-        mask = np.asarray(mask)
+        plo, phi, thr = quorum_args
+        mask, reached_dev, _, _ = _certify_kernel(
+            zw,
+            r,
+            s,
+            v,
+            claimed,
+            jnp.asarray(table),
+            live,
+            jnp.asarray(plo),
+            jnp.asarray(phi),
+            jnp.int32(max(thr, 0) & 0xFFFF),
+            jnp.int32(max(thr, 0) >> 16),
+        )
+        return mask, reached_dev
+
+    @staticmethod
+    def _readback(handle) -> Tuple[np.ndarray, Optional[bool]]:
+        """Block on one :meth:`_dispatch_async` handle -> host results."""
+        mask_dev, reached_dev = handle
+        mask = np.asarray(mask_dev)
+        reached = None if reached_dev is None else bool(np.asarray(reached_dev))
+        return mask, reached
+
+    def _dispatch(self, inputs, table, quorum_args, metric: str):
+        """Synchronous pack->kernel->readback (single-batch callers)."""
+        t0 = time.perf_counter()
+        mask, reached = self._readback(
+            self._dispatch_async(inputs, table, quorum_args)
+        )
         metrics.observe(
             ("go-ibft", "device", metric), (time.perf_counter() - t0) * 1e3
         )
@@ -484,8 +722,18 @@ class DeviceBatchVerifier:
         rows; the expensive part — the recovery ladder — still runs on
         device for every lane.  Serves both the per-phase dispatches and
         (via ``pad_lanes``) the single-dispatch ``certify_round`` packing.
+
+        Payload encodings and limb rows come from the pack cache when this
+        engine already packed the message (certificate re-validation runs
+        per round-change wakeup over the same envelopes); fresh lanes pack
+        vectorized and store back.
         """
-        payloads = [m.encode(include_signature=False) for m in msgs]
+        cache = self._pack_cache
+        hits = [cache.lookup(m) for m in msgs]
+        payloads = [
+            h.payload if h is not None else m.encode(include_signature=False)
+            for h, m in zip(hits, msgs)
+        ]
         big = [
             i for i, p in enumerate(payloads) if len(p) > self._MAX_DEVICE_PAYLOAD
         ]
@@ -496,13 +744,18 @@ class DeviceBatchVerifier:
         else:
             device_payloads = payloads
         blocks, counts, r, s, v, senders, live = pack_sender_batch(
-            msgs, pad_lanes=pad_lanes, payloads=device_payloads
+            msgs,
+            pad_lanes=pad_lanes,
+            payloads=device_payloads,
+            cache=cache,
+            cache_payloads=payloads,
+            cache_hits=hits,
         )
         zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
         if big:
             zw = np.array(zw)  # writable host copy (np.asarray can be RO)
-            for i in big:
-                digest = keccak256(payloads[i])
+            digests = keccak256_many([payloads[i] for i in big])
+            for i, digest in zip(big, digests):
                 zw[i] = np.frombuffer(digest, ">u4")[::-1].astype(np.uint32)
         return zw, r, s, v, senders, live
 
@@ -517,7 +770,10 @@ class DeviceBatchVerifier:
             raise ValueError(f"fused quorum unsupported for height {height}")
         table, plo, phi, quorum_size = pack
         thr = quorum_size if threshold is None else threshold
-        return table, (plo, phi, thr), thr
+        # Device-resident handles: the table and power vectors upload once
+        # per height; jnp.asarray at the dispatch edge is then a no-op.
+        plo_dev, phi_dev = self._quorum_powers_dev(height, plo, phi)
+        return self._table_dev(height), (plo_dev, phi_dev, thr), thr
 
     def certify_senders(
         self, msgs: Sequence[IbftMessage], height: int, threshold: Optional[int] = None
@@ -659,6 +915,27 @@ class DeviceBatchVerifier:
 
     # -- BatchVerifier protocol ----------------------------------------
 
+    def _run_chunk_pipeline(self, items, pack, metric: str):
+        """Pipeline (pack -> async dispatch -> readback) over chunk items.
+
+        ``items`` are opaque chunk descriptors; ``pack(item)`` returns
+        ``(item, inputs, table_dev)``.  Host packing of chunk N+1 overlaps
+        device execution of chunk N (double buffered) — a flood above the
+        largest lane bucket no longer serializes pack/dispatch/readback
+        per chunk.  Returns ``[(item, mask), ...]`` in item order.
+        """
+        t0 = time.perf_counter()
+        report = VerifyPipeline(depth=2).run(
+            items,
+            pack,
+            dispatch=lambda p: (p[0], self._dispatch_async(p[1], p[2], None)),
+            readback=lambda h: (h[0], self._readback(h[1])[0]),
+        )
+        metrics.observe(
+            ("go-ibft", "device", metric), (time.perf_counter() - t0) * 1e3
+        )
+        return report.results
+
     def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
         if not msgs:
             return np.zeros(0, dtype=bool)
@@ -667,19 +944,31 @@ class DeviceBatchVerifier:
         for i, m in enumerate(msgs):
             if self._well_formed_sender(m, None):
                 by_height.setdefault(m.view.height, []).append(i)
-        for height, idxs in by_height.items():
-            # Floods above the largest lane bucket run as multiple full
-            # dispatches — a 2049-message burst costs two kernel launches,
-            # not 2049 sequential host recovers (VERDICT r04 weak #6).
-            for start in range(0, len(idxs), _BATCH_BUCKETS[-1]):
-                chunk = idxs[start : start + _BATCH_BUCKETS[-1]]
-                mask, _ = self._dispatch(
-                    self._sender_inputs([msgs[i] for i in chunk]),
-                    self._table(height),
-                    None,
-                    "verify_senders_ms",
-                )
-                out[np.asarray(chunk)] = mask[: len(chunk)]
+        # Floods above the largest lane bucket run as multiple full
+        # dispatches — a 2049-message burst costs two kernel launches, not
+        # 2049 sequential host recovers (VERDICT r04 weak #6) — and the
+        # chunks ride the double-buffered pipeline: chunk N+1 packs on host
+        # while chunk N executes.
+        items = [
+            (height, idxs[start : start + _BATCH_BUCKETS[-1]])
+            for height, idxs in by_height.items()
+            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+        ]
+        if not items:
+            return out
+
+        def pack(item):
+            height, chunk = item
+            return (
+                item,
+                self._sender_inputs([msgs[i] for i in chunk]),
+                self._table_dev(height),
+            )
+
+        for (_, chunk), mask in self._run_chunk_pipeline(
+            items, pack, "verify_senders_ms"
+        ):
+            out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
     def verify_committed_seals(
@@ -689,16 +978,77 @@ class DeviceBatchVerifier:
         idxs = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
         if not idxs or len(proposal_hash) != 32:
             return out
-        for start in range(0, len(idxs), _BATCH_BUCKETS[-1]):
-            chunk = idxs[start : start + _BATCH_BUCKETS[-1]]
-            mask, _ = self._dispatch(
+        items = [
+            idxs[start : start + _BATCH_BUCKETS[-1]]
+            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+        ]
+
+        def pack(chunk):
+            return (
+                chunk,
                 self._seal_inputs(proposal_hash, [seals[i] for i in chunk]),
-                self._table(height),
-                None,
-                "verify_seals_ms",
+                self._table_dev(height),
             )
+
+        for chunk, mask in self._run_chunk_pipeline(
+            items, pack, "verify_seals_ms"
+        ):
             out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
+
+    def verify_round_chunked(
+        self,
+        msgs: Sequence[IbftMessage],
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """BOTH phases' drains through ONE pipeline (oversize rounds).
+
+        PREPARE-envelope chunks and COMMIT-seal chunks share the in-flight
+        window, so the seal packing overlaps the tail envelope dispatches —
+        the multi-phase drain shape ``AdaptiveBatchVerifier.certify_round``
+        routes floods above the fused-dispatch bucket through.  Masks only;
+        the quorum reduction stays with the caller (exact host ints).
+        Envelopes are height-gated like the certify paths.
+        """
+        sender_mask = np.zeros(len(msgs), dtype=bool)
+        seal_mask = np.zeros(len(seals), dtype=bool)
+        cap = _BATCH_BUCKETS[-1]
+        midx = [
+            i for i, m in enumerate(msgs) if self._well_formed_sender(m, height)
+        ]
+        sidx = (
+            [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
+            if len(proposal_hash) == 32
+            else []
+        )
+        items = [
+            ("sender", midx[start : start + cap])
+            for start in range(0, len(midx), cap)
+        ] + [
+            ("seal", sidx[start : start + cap])
+            for start in range(0, len(sidx), cap)
+        ]
+        if not items:
+            return sender_mask, seal_mask
+
+        def pack(item):
+            kind, chunk = item
+            if kind == "sender":
+                inputs = self._sender_inputs([msgs[i] for i in chunk])
+            else:
+                inputs = self._seal_inputs(
+                    proposal_hash, [seals[i] for i in chunk]
+                )
+            return item, inputs, self._table_dev(height)
+
+        for (kind, chunk), mask in self._run_chunk_pipeline(
+            items, pack, "round_drain_ms"
+        ):
+            target = sender_mask if kind == "sender" else seal_mask
+            target[np.asarray(chunk)] = mask[: len(chunk)]
+        return sender_mask, seal_mask
 
 
 class AdaptiveBatchVerifier:
@@ -743,6 +1093,13 @@ class AdaptiveBatchVerifier:
 
     def warmup(self, **kw) -> None:
         self.device.warmup(**kw)
+
+    def note_round(self, round_: int) -> None:
+        """Engine hook: forward round advances to the device pack cache."""
+        self.device.note_round(round_)
+
+    def reset_pack_cache(self) -> None:
+        self.device.reset_pack_cache()
 
     # -- host-side quorum (exact big ints) ------------------------------
 
@@ -855,6 +1212,33 @@ class AdaptiveBatchVerifier:
             return self.device.certify_round(
                 msgs, proposal_hash, seals, height, prepare_threshold
             )
+        if (
+            msgs
+            and seals
+            and len(proposal_hash) == 32
+            and self._chunked_device(max(len(msgs), len(seals)), height)
+            and min(len(msgs), len(seals)) >= self.cutover
+            # injected device stubs (tests, embedders) may predate the
+            # cross-phase drain; fall back to the per-phase routes then
+            and hasattr(self.device, "verify_round_chunked")
+        ):
+            # Oversize round: BOTH phases drain through one device pipeline
+            # (seal packing overlaps the tail envelope dispatches); quorum
+            # reduces on exact host ints like every chunked route.
+            sender_mask, seal_mask = self.device.verify_round_chunked(
+                msgs, proposal_hash, seals, height
+            )
+            p_ok = self._host_reached(
+                [m.sender for m, ok in zip(msgs, sender_mask) if ok],
+                height,
+                prepare_threshold,
+            )
+            s_ok = self._host_reached(
+                [s.signer for s, ok in zip(seals, seal_mask) if ok],
+                height,
+                None,
+            )
+            return sender_mask, p_ok, seal_mask, s_ok
         sender_mask, p_ok = self.certify_senders(
             msgs, height, threshold=prepare_threshold
         )

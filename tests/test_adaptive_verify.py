@@ -226,7 +226,10 @@ def test_device_verifier_chunks_oversize_floods(monkeypatch):
     dev = DeviceBatchVerifier(src)
     sizes = []
 
-    def fake_dispatch(inputs, table, quorum_args, metric):
+    def fake_dispatch_async(inputs, table, quorum_args):
+        # The pipelined chunk drain queues via _dispatch_async and blocks
+        # in _readback; the stub returns host arrays, which _readback
+        # passes through unchanged.
         live = np.asarray(inputs[-1])
         sizes.append(int(live.sum()))
         # lane pattern: valid iff even position within the chunk
@@ -234,7 +237,7 @@ def test_device_verifier_chunks_oversize_floods(monkeypatch):
         mask[: int(live.sum()) : 2] = True
         return mask, None
 
-    monkeypatch.setattr(dev, "_dispatch", fake_dispatch)
+    monkeypatch.setattr(dev, "_dispatch_async", fake_dispatch_async)
     monkeypatch.setattr(
         dev, "_sender_inputs", lambda ms: (None,) * 5 + (np.ones(len(ms), bool),)
     )

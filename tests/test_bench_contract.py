@@ -104,6 +104,34 @@ def test_driver_conditions_all_configs_measure(driver_run):
         assert "skipped" not in str(line.get("note", "")), line
 
 
+def test_driver_conditions_config3_pipelined_packing_evidence(driver_run):
+    """Config #3's host-routed line carries the packing/pipelining
+    attribution fields and pins (a) pipelined-vs-sequential dispatch
+    throughput and (b) a packing-throughput floor under driver conditions.
+
+    Overlap needs parallel hardware: the pipelined leg runs packing on the
+    main thread against a GIL-releasing bulk verify in a worker, so on a
+    multi-CPU host the ratio must reach >= 1.0; on a single-CPU host, or
+    without the native verifier (pure-Python verify holds the GIL, so the
+    legs time-slice regardless of cores), the honest pin is "pipelining
+    does not regress dispatch throughput" (>= 0.9 absorbs scheduler noise
+    — the structural overlap itself is pinned hardware-independently by
+    tests/test_pipeline_overlap.py with a timer-stub device).  The packing
+    floor is pinned when the native verifier is present (the no-native
+    path scales n down to 8, where per-call overhead dominates the
+    lanes/s figure)."""
+    _, by_metric = driver_run
+    line = by_metric["ecdsa_1000v_10h_pipelined_throughput"]
+    assert line["pack_ms"] > 0, line
+    assert "pipeline_speedup" in line and "overlap_efficiency" in line, line
+    if line.get("cpus", 1) > 1 and line.get("native_verify"):
+        assert line["pipeline_speedup"] >= 1.0, line
+    else:
+        assert line["pipeline_speedup"] >= 0.9, line
+    if line.get("native_verify"):
+        assert line["pack_lanes_per_s"] >= 25_000, line
+
+
 def test_driver_conditions_happy_path_parity(driver_run):
     """The parity acceptance metric, pinned under driver conditions: the
     adaptive engine must at least break even against the forced sequential

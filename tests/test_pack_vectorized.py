@@ -1,0 +1,332 @@
+"""Byte-identity of the vectorized packers vs the reference loop packers.
+
+The vectorized data plane (verify/batch.py, ops/keccak.py::pack_messages)
+must produce BIT-IDENTICAL arrays to the kept per-message loop packers
+(``_pack_*_reference``) across batch buckets, oversize payloads, corrupt
+lanes, and padding edges — same contract as the host/device mask parity:
+the packing rewrite must be invisible to the kernels.  Plus the empty-
+input guards (n=0 used to raise through ``max()``) and the round-scoped
+:class:`~go_ibft_tpu.verify.pipeline.PackCache` semantics.
+"""
+
+import gc
+import random
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+from go_ibft_tpu.messages.helpers import CommittedSeal, extract_committed_seal
+from go_ibft_tpu.messages.wire import Proposal, View
+from go_ibft_tpu.ops.keccak import (
+    _pack_messages_reference,
+    addresses_to_words,
+    pack_messages,
+)
+from go_ibft_tpu.verify.batch import (
+    SIG_BYTES,
+    _pack_seal_batch_reference,
+    _pack_sender_batch_reference,
+    pack_seal_batch,
+    pack_sender_batch,
+    split_signature,
+)
+from go_ibft_tpu.verify.pipeline import PackCache, SenderPack
+
+
+def _signed(n, height=1, seed=0):
+    keys = [PrivateKey.from_seed(b"pv-%d-%d" % (seed, i)) for i in range(n)]
+    powers = {k.address: 1 for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=height, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"pv block", round=0))
+    prepares = [b.build_prepare_message(phash, view) for b in backends]
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    return prepares, seals, phash
+
+
+def _assert_tuples_identical(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, f"part {i}: {x.dtype} != {y.dtype}"
+        assert x.shape == y.shape, f"part {i}: {x.shape} != {y.shape}"
+        assert np.array_equal(x, y), f"part {i} differs"
+
+
+# -- sender/seal batch parity ------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 3, 7, 8, 9, 32, 33])
+def test_sender_batch_parity_across_buckets(n):
+    prepares, _, _ = _signed(n)
+    if n >= 3:  # corrupt-signature lane: parity must hold bit-for-bit
+        sig = bytearray(prepares[2].signature)
+        sig[5] ^= 0xFF
+        prepares[2].signature = bytes(sig)
+    _assert_tuples_identical(
+        pack_sender_batch(prepares), _pack_sender_batch_reference(prepares)
+    )
+
+
+@pytest.mark.parametrize("n", [1, 4, 8, 9])
+def test_seal_batch_parity_across_buckets(n):
+    _, seals, phash = _signed(n)
+    if n >= 2:  # garbage signature of the right length
+        seals[1] = CommittedSeal(signer=seals[1].signer, signature=b"\x01" * 65)
+    _assert_tuples_identical(
+        pack_seal_batch(phash, seals), _pack_seal_batch_reference(phash, seals)
+    )
+
+
+def test_sender_batch_parity_with_pad_lanes_and_payload_override():
+    prepares, _, _ = _signed(3)
+    payloads = [m.encode(include_signature=False) for m in prepares]
+    payloads[1] = b""  # the oversize path substitutes empty payloads
+    _assert_tuples_identical(
+        pack_sender_batch(prepares, pad_lanes=32, payloads=payloads),
+        _pack_sender_batch_reference(prepares, pad_lanes=32, payloads=payloads),
+    )
+
+
+def test_sender_batch_parity_oversize_payload_rides_next_bucket():
+    """A multi-block payload (well under the bucket max) packs identically."""
+    prepares, _, _ = _signed(2)
+    payloads = [m.encode(include_signature=False) for m in prepares]
+    payloads[0] = bytes(range(256)) * 4  # 1024B -> 8 rate blocks
+    _assert_tuples_identical(
+        pack_sender_batch(prepares, payloads=payloads),
+        _pack_sender_batch_reference(prepares, payloads=payloads),
+    )
+
+
+def test_sender_batch_too_big_payload_raises_like_reference():
+    prepares, _, _ = _signed(1)
+    payloads = [bytes(10_000)]  # > largest block bucket
+    with pytest.raises(ValueError):
+        pack_sender_batch(prepares, payloads=payloads)
+    with pytest.raises(ValueError):
+        _pack_sender_batch_reference(prepares, payloads=payloads)
+
+
+# -- empty-input guards ------------------------------------------------------
+
+
+def test_empty_sender_batch_is_fully_dead():
+    blocks, counts, r, s, v, senders, live = pack_sender_batch([])
+    assert blocks.shape == (8, 2, 17, 2) and not blocks.any()
+    assert counts.shape == (8,) and (counts == 1).all()
+    assert not live.any()
+    assert not r.any() and not s.any() and not v.any() and not senders.any()
+
+
+def test_empty_sender_batch_respects_pad_lanes():
+    out = pack_sender_batch([], pad_lanes=32)
+    assert out[0].shape[0] == 32 and not out[6].any()
+
+
+def test_empty_seal_batch_is_fully_dead():
+    phash = b"\x07" * 32
+    hz, r, s, v, signers, live = pack_seal_batch(phash, [])
+    assert hz.shape == (8, 8)
+    # the hash still broadcasts (same layout as the reference's n>0 path)
+    expect = np.frombuffer(phash, ">u4")[::-1].astype(np.uint32)
+    assert (hz == expect).all()
+    assert not live.any() and not signers.any()
+
+
+def test_bucket_boundary_counts():
+    """n exactly at / one past a lane bucket pads to the right shapes."""
+    for n, want in ((8, 8), (9, 32)):
+        prepares, seals, phash = _signed(n)
+        assert pack_sender_batch(prepares)[0].shape[0] == want
+        assert pack_seal_batch(phash, seals)[0].shape[0] == want
+
+
+# -- block packing parity ----------------------------------------------------
+
+
+def test_pack_messages_parity_edge_lengths():
+    rng = random.Random(7)
+    cases = [
+        [b""],
+        [b"x"],
+        [bytes(135)],
+        [bytes(136)],
+        [bytes(137)],
+        [bytes([rng.randrange(256) for _ in range(rng.randrange(0, 300))]) for _ in range(17)],
+        [b"y" * 64] * 9,  # uniform-length fast path
+    ]
+    for payloads in cases:
+        for max_blocks in (2, 8):
+            a = pack_messages(payloads, max_blocks)
+            b = _pack_messages_reference(payloads, max_blocks)
+            assert np.array_equal(a[0], b[0])
+            assert np.array_equal(a[1], b[1]) and a[1].dtype == b[1].dtype
+
+
+def test_pack_messages_oversize_raises_both():
+    for fn in (pack_messages, _pack_messages_reference):
+        with pytest.raises(ValueError):
+            fn([bytes(300)], 2)
+
+
+def test_addresses_to_words_matches_scalar_and_validates():
+    from go_ibft_tpu.ops.keccak import address_to_words
+
+    addrs = [bytes([i]) * 20 for i in range(5)]
+    bulk = addresses_to_words(addrs)
+    for i, a in enumerate(addrs):
+        assert (bulk[i] == address_to_words(a)).all()
+    with pytest.raises(ValueError):
+        addresses_to_words([b"\x01" * 19])
+    assert addresses_to_words([]).shape == (0, 5)
+
+
+# -- split_signature round trip ---------------------------------------------
+
+
+def _rt_case(r, s, v):
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+    assert split_signature(sig) == (r, s, v)
+    # and the vectorized splitter agrees limb-for-limb with the loop path
+    from go_ibft_tpu.ops import secp256k1 as sec
+    from go_ibft_tpu.ops.fields import to_limbs
+    from go_ibft_tpu.verify.batch import _split_signatures, _words_to_limbs
+
+    rw, sw, vv = _split_signatures([sig])
+    nl = sec.FIELD.nlimbs
+    assert np.array_equal(_words_to_limbs(rw, nl), to_limbs([r], nl))
+    assert np.array_equal(_words_to_limbs(sw, nl), to_limbs([s], nl))
+    assert int(vv[0]) == v
+
+
+try:
+    from hypothesis import given
+    from hypothesis import strategies as st
+
+    @given(
+        st.integers(min_value=0, max_value=(1 << 256) - 1),
+        st.integers(min_value=0, max_value=(1 << 256) - 1),
+        st.integers(min_value=0, max_value=255),
+    )
+    def test_split_signature_round_trip(r, s, v):
+        _rt_case(r, s, v)
+
+except ImportError:  # hypothesis absent: seeded-random fallback, same property
+
+    def test_split_signature_round_trip():
+        rng = random.Random(1234)
+        edge = [0, 1, (1 << 256) - 1, (1 << 255), (1 << 13) - 1, 1 << 13]
+        values = edge + [rng.getrandbits(256) for _ in range(64)]
+        for r in values[:8]:
+            for s in values[:8]:
+                _rt_case(r, s, rng.randrange(256))
+        for _ in range(64):
+            _rt_case(rng.getrandbits(256), rng.getrandbits(256), rng.randrange(256))
+
+
+def test_split_signature_rejects_wrong_length():
+    with pytest.raises(ValueError):
+        split_signature(b"\x00" * 64)
+    from go_ibft_tpu.verify.batch import _split_signatures
+
+    with pytest.raises(ValueError):
+        _split_signatures([b"\x00" * SIG_BYTES, b"\x00" * 64])
+
+
+# -- pack cache --------------------------------------------------------------
+
+
+def test_pack_cache_hit_skips_reencode_and_stays_identical():
+    prepares, _, _ = _signed(4)
+    cache = PackCache()
+    cold = pack_sender_batch(prepares, cache=cache)
+    assert len(cache) == 4
+
+    encodes = []
+    orig = type(prepares[0]).encode
+
+    def counting_encode(self, **kw):
+        encodes.append(1)
+        return orig(self, **kw)
+
+    type(prepares[0]).encode = counting_encode
+    try:
+        warm = pack_sender_batch(prepares, cache=cache)
+    finally:
+        type(prepares[0]).encode = orig
+    assert encodes == []  # no message re-encoded on a warm cache
+    _assert_tuples_identical(warm, cold)
+    _assert_tuples_identical(warm, _pack_sender_batch_reference(prepares))
+
+
+def test_pack_cache_signature_mutation_is_a_miss():
+    prepares, _, _ = _signed(2)
+    cache = PackCache()
+    pack_sender_batch(prepares, cache=cache)
+    sig = bytearray(prepares[0].signature)
+    sig[5] ^= 0xFF
+    prepares[0].signature = bytes(sig)
+    assert cache.lookup(prepares[0]) is None  # token mismatch
+    # re-pack picks up the new signature and matches the reference exactly
+    _assert_tuples_identical(
+        pack_sender_batch(prepares, cache=cache),
+        _pack_sender_batch_reference(prepares),
+    )
+
+
+def test_pack_cache_round_scoped_eviction_oldest_first():
+    cache = PackCache(cap=4)
+
+    class _Msg:
+        def __init__(self, tag):
+            self.sender = b"\x01" * 20
+            self.signature = bytes([tag]) * 65
+
+    def lane(payload):
+        z = np.zeros(20, np.int32)
+        return SenderPack(payload, z, z, 0, np.zeros(5, np.uint32))
+
+    keep = []
+    for round_, tags in ((0, (1, 2)), (1, (3, 4))):
+        cache.note_round(round_)
+        for t in tags:
+            m = _Msg(t)
+            keep.append(m)
+            cache.store(m, lane(b"p%d" % t))
+    assert len(cache) == 4
+    cache.note_round(2)
+    extra = _Msg(9)
+    keep.append(extra)
+    cache.store(extra, lane(b"p9"))
+    # cap 4: round-0 entries (the oldest round) evicted wholesale first
+    assert cache.lookup(keep[0]) is None and cache.lookup(keep[1]) is None
+    assert cache.lookup(keep[2]) is not None
+    assert cache.lookup(extra) is not None
+
+
+def test_pack_cache_dead_object_entry_is_dropped():
+    cache = PackCache()
+    prepares, _, _ = _signed(1)
+    pack_sender_batch(prepares, cache=cache)
+    assert len(cache) == 1
+    del prepares
+    gc.collect()
+    assert len(cache) == 0  # weakref death callback pruned the entry
+
+
+def test_pack_cache_clear_and_note_round():
+    prepares, _, _ = _signed(2)
+    cache = PackCache()
+    cache.note_round(3)
+    pack_sender_batch(prepares, cache=cache)
+    assert len(cache) == 2
+    cache.clear()
+    assert len(cache) == 0
+    assert cache.lookup(prepares[0]) is None

@@ -693,6 +693,134 @@ def config5_host_scaled() -> None:
     )
 
 
+def config6_chaos() -> None:
+    """100-validator quorum drain under a FIXED fault schedule (seed 1337):
+    degraded-mode overhead as first-class evidence.
+
+    The drain carries corrupted (bit-flipped) lanes, malformed
+    (wrong-length-signature) lanes, and a fast rung that randomly raises
+    the simulated XLA dispatch error per the injector's deterministic
+    schedule.  The ResilientBatchVerifier must return the exact oracle
+    verdicts every rep without raising; the reported value is the
+    wall-clock ratio of the chaotic drain to the clean drain on the same
+    rung — what surviving a flaky device costs.  Runs on every backend
+    (host rung stands in for the device on CPU fallback; a live TPU run
+    wraps the real DeviceBatchVerifier).
+    """
+    from go_ibft_tpu.chaos import ChaoticVerifier, FaultConfig, FaultInjector
+    from go_ibft_tpu.utils import metrics
+    from go_ibft_tpu.verify import (
+        CircuitBreaker,
+        HostBatchVerifier,
+        ResilientBatchVerifier,
+    )
+    from go_ibft_tpu.verify.batch import (
+        QUARANTINED_LANES_KEY,
+        pack_seal_batch,
+        pack_sender_batch,
+    )
+    from go_ibft_tpu.verify.pipeline import BREAKER_TRANSITIONS_KEY
+
+    seed = 1337
+    n = _host_scale(100, 8)
+    prepares, seals, phash, src, expected = _signed_round(
+        n, seed=6, corrupt_frac=0.1
+    )
+    malformed = (1, n // 2)
+    for i in malformed:
+        prepares[i].signature = prepares[i].signature[:30]  # truncated lane
+        expected[i] = False
+
+    host = HostBatchVerifier(src)
+
+    class _StrictRung:
+        """Fast rung: strict vectorized packing (malformed lanes raise
+        MalformedLaneError -> quarantine path) + the backend verifier."""
+
+        def __init__(self, inner):
+            self.inner = inner
+
+        def verify_senders(self, msgs):
+            pack_sender_batch(list(msgs))
+            return self.inner.verify_senders(msgs)
+
+        def verify_committed_seals(self, proposal_hash, seal_batch, height):
+            pack_seal_batch(proposal_hash, list(seal_batch))
+            return self.inner.verify_committed_seals(
+                proposal_hash, seal_batch, height
+            )
+
+    if _FALLBACK:
+        fast_inner = HostBatchVerifier(src)
+    else:
+        from go_ibft_tpu.verify import DeviceBatchVerifier
+
+        fast_inner = DeviceBatchVerifier(src)
+
+    # Clean drain baseline on the same rung (no injector, no malformed
+    # lanes: drop them so packing succeeds end to end).
+    clean_rung = _StrictRung(fast_inner)
+    clean_msgs = [m for i, m in enumerate(prepares) if i not in malformed]
+    reps = 3 if _FALLBACK else _reps()
+    clean_rung.verify_senders(clean_msgs)  # warm (compile on device)
+    clean_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        clean_rung.verify_senders(clean_msgs)
+        clean_rung.verify_committed_seals(phash, seals, 1)
+        clean_times.append((time.perf_counter() - t0) * 1e3)
+
+    injector = FaultInjector(
+        seed, FaultConfig(device_error_rate=0.3, slow_verify_rate=0.0)
+    )
+    resilient = ResilientBatchVerifier(
+        ChaoticVerifier(_StrictRung(fast_inner), injector, site="verify:bench"),
+        host=host,
+        validators_for_height=src,
+        breaker=CircuitBreaker(k=3, cooldown_s=0.1),
+    )
+    q_before = metrics.get_counter(QUARANTINED_LANES_KEY)
+    err_before = metrics.get_counter(("go-ibft", "chaos", "device_errors"))
+    transitions_before = len(metrics.get_histogram(BREAKER_TRANSITIONS_KEY))
+    chaos_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        mask = resilient.verify_senders(prepares)
+        seal_mask = resilient.verify_committed_seals(phash, seals, 1)
+        chaos_times.append((time.perf_counter() - t0) * 1e3)
+        assert (np.asarray(mask) == expected).all(), (
+            f"degraded-mode verdicts diverged from oracle (seed {seed})"
+        )
+        assert np.asarray(seal_mask)[expected].all()
+
+    clean_ms = statistics.median(clean_times)
+    chaos_ms = statistics.median(chaos_times)
+    _log(
+        {
+            "metric": config6_chaos.metric,
+            "value": round(chaos_ms / clean_ms, 2),
+            "unit": "x clean drain",
+            "vs_baseline": None,
+            "chaos_seed": seed,
+            "schedule_digest": injector.schedule_digest(("verify:bench",)),
+            "clean_p50_ms": round(clean_ms, 3),
+            "chaos_p50_ms": round(chaos_ms, 3),
+            "lanes": n,
+            "quarantined_lanes": metrics.get_counter(QUARANTINED_LANES_KEY)
+            - q_before,
+            "injected_device_errors": metrics.get_counter(
+                ("go-ibft", "chaos", "device_errors")
+            )
+            - err_before,
+            "breaker_transitions": len(
+                metrics.get_histogram(BREAKER_TRANSITIONS_KEY)
+            )
+            - transitions_before,
+            "variant": "host rung" if _FALLBACK else "device rung",
+        }
+    )
+
+
 def config2_host_fallback() -> None:
     """Config #2 CPU-fallback variant: whole-round verify on the host route.
 
@@ -935,6 +1063,7 @@ config1_happy_path.metric = "happy_path_4v_height_latency"
 config3_pipelined.metric = "ecdsa_1000v_10h_pipelined_throughput"
 config4_bls.metric = "bls_aggregate_verify_p50_100v"
 config5_byzantine_mix.metric = "byzantine_300v_30pct_prepare_commit_p50"
+config6_chaos.metric = "chaos_degraded_overhead_100v"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -984,9 +1113,10 @@ def main() -> None:
         )
         failures = []
         for config_fn, reserve in (
-            (config3_host_scaled, 150.0),
-            (config4_host_scaled, 100.0),
-            (config5_host_scaled, 70.0),
+            (config3_host_scaled, 170.0),
+            (config4_host_scaled, 120.0),
+            (config5_host_scaled, 90.0),
+            (config6_chaos, 65.0),
             (config2_host_fallback, 45.0),
         ):
             _guarded(config_fn, failures, reserve_s=reserve)
@@ -1039,7 +1169,8 @@ def main() -> None:
         (config1_happy_path, 480.0),
         (config3_pipelined, 420.0),
         (config4_bls, 360.0),
-        (config5_byzantine_mix, 300.0),
+        (config5_byzantine_mix, 320.0),
+        (config6_chaos, 300.0),
     ):
         _guarded(config_fn, failures, reserve_s=reserve)
     # Headline LAST: drivers read the final JSON line.  Guarded so a

@@ -1,0 +1,52 @@
+"""Chaos-injection harness: deterministic faults at the engine's seams.
+
+BFT deployments are defined by how they behave under loss and adversarial
+input; this package makes that testable on every commit.  A seed-driven
+:class:`FaultInjector` produces a replayable fault schedule (same seed =>
+byte-identical decisions, pinned in tests/test_chaos.py) and a family of
+wrappers applies it at the three seams the engine already exposes:
+
+* transports / deliver callables — drops, delays, reordering, duplication,
+  wire-encoding bit-flips (:class:`ChaoticDeliver`/:class:`ChaoticTransport`);
+* batch verifiers and crypto backends — slow verifies and simulated XLA
+  ``RuntimeError`` on dispatch (:class:`ChaoticVerifier`/:class:`ChaoticBackend`);
+* pipeline dispatch callables (:func:`chaotic_dispatch`).
+
+Any chaos-test failure prints a ``CHAOS-REPLAY`` line with the seed and
+schedule digest (:func:`replay_on_failure`); ``scripts/chaos_replay.py``
+re-runs the scenario from that seed.  The degraded-mode machinery these
+faults exercise lives in :mod:`go_ibft_tpu.verify` (quarantine bisection +
+circuit breaker); see docs/ROBUSTNESS.md for the full fault model.
+"""
+
+from .injector import (
+    FaultConfig,
+    FaultInjector,
+    InjectedDeviceError,
+    TransportFault,
+    VerifyFault,
+    replay_on_failure,
+)
+from .wrappers import (
+    ChaoticBackend,
+    ChaoticDeliver,
+    ChaoticTransport,
+    ChaoticVerifier,
+    chaotic_dispatch,
+    corrupt_message,
+)
+
+__all__ = [
+    "FaultConfig",
+    "FaultInjector",
+    "InjectedDeviceError",
+    "TransportFault",
+    "VerifyFault",
+    "replay_on_failure",
+    "ChaoticBackend",
+    "ChaoticDeliver",
+    "ChaoticTransport",
+    "ChaoticVerifier",
+    "chaotic_dispatch",
+    "corrupt_message",
+]

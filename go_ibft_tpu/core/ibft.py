@@ -47,13 +47,24 @@ DEFAULT_BASE_ROUND_TIMEOUT = 10.0
 
 _ROUND_FACTOR_BASE = 2.0
 
+# Exponent cap for the round-timeout formula: 2.0**round_ raises
+# OverflowError past round ~1023, so a long-stalled sequence (or a
+# Byzantine-driven round jump) would CRASH the timer worker instead of
+# timing out.  10s * 2^62 is ~1.5e12 years — indistinguishable from
+# "forever" while staying finite, monotone, and arithmetic-safe.
+MAX_TIMEOUT_EXPONENT = 62
+
 
 def get_round_timeout(
     base_round_timeout: float, additional_timeout: float, round_: int
 ) -> float:
     """Exponential round timeout: base·2^round + additional
-    (reference core/ibft.go:1300-1315)."""
-    return base_round_timeout * (_ROUND_FACTOR_BASE**round_) + additional_timeout
+    (reference core/ibft.go:1300-1315).  The exponent saturates at
+    ``MAX_TIMEOUT_EXPONENT`` so arbitrarily high rounds return a finite
+    timeout instead of raising ``OverflowError`` (the reference's Go
+    ``time.Duration`` shift overflows silently there; we saturate)."""
+    exponent = min(round_, MAX_TIMEOUT_EXPONENT)
+    return base_round_timeout * (_ROUND_FACTOR_BASE**exponent) + additional_timeout
 
 
 class _NewProposalEvent:

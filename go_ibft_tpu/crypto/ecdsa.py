@@ -189,9 +189,9 @@ def verify(x: int, y: int, digest: bytes, r: int, s: int) -> bool:
 
 def recover(digest: bytes, r: int, s: int, v: int) -> Optional[Tuple[int, int]]:
     """Public-key recovery; ``None`` on any invalid input."""
-    if not (0 < r < N and 0 < s < N) or v not in (0, 1):
-        return None
     if _native_recover is not None:
+        if not (0 < r < N and 0 < s < N) or v not in (0, 1):
+            return None
         out = _native_recover(
             digest, r.to_bytes(32, "big") + s.to_bytes(32, "big"), v
         )
@@ -200,6 +200,18 @@ def recover(digest: bytes, r: int, s: int, v: int) -> Optional[Tuple[int, int]]:
             if out is None
             else (int.from_bytes(out[:32], "big"), int.from_bytes(out[32:], "big"))
         )
+    return recover_pure(digest, r, s, v)
+
+
+def recover_pure(digest: bytes, r: int, s: int, v: int) -> Optional[Tuple[int, int]]:
+    """Pure-Python recovery, never delegating to the native library.
+
+    The bottom rung of the degraded-mode verify ladder
+    (:class:`go_ibft_tpu.verify.ResilientBatchVerifier`): survives a native
+    library that has started crashing or returning garbage, at ~90 ms per
+    recover.  Bit-identical to :func:`recover` (tests/test_native.py)."""
+    if not (0 < r < N and 0 < s < N) or v not in (0, 1):
+        return None
     x = r
     y2 = (x * x * x + 7) % P
     y = pow(y2, (P + 1) // 4, P)

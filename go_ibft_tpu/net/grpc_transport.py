@@ -16,21 +16,33 @@ core/transport.go:8-9) and never touches the network.
 
 Fire-and-forget semantics match the reference: delivery failures are
 logged and dropped — consensus liveness is the protocol's job (round
-changes), not the transport's.
+changes), not the transport's.  Since ISSUE 3 a failed send is retried
+with jittered exponential backoff inside a bounded send deadline: a
+transiently lossy link recovers without waiting a whole round change,
+while the deadline keeps every retry sequence strictly shorter than the
+round-0 timeout so the transport can never outlive the round semantics it
+serves (``core/ibft.py::get_round_timeout``).
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Callable, Dict, Optional, Sequence
 
 import grpc
+
+from ..core.ibft import DEFAULT_BASE_ROUND_TIMEOUT
+from ..utils import metrics
 
 from ..messages.wire import IbftMessage
 
 _SERVICE = "goibft.Transport"
 _METHOD = "Multicast"
 _FULL_METHOD = f"/{_SERVICE}/{_METHOD}"
+
+RETRY_KEY = ("go-ibft", "transport", "retries")
+SEND_FAILURE_KEY = ("go-ibft", "transport", "send_failures")
 
 
 def _identity(b: bytes) -> bytes:
@@ -45,12 +57,24 @@ class GrpcTransport:
     shutdown.  ``peers`` maps peer name -> ``host:port`` target.
     """
 
+    # Retry policy: total budget per (message, peer) send.  The deadline is
+    # clamped strictly below the round-0 timeout — a send retried past the
+    # round it belongs to is pure waste (the round change already
+    # superseded it) and must never keep the event loop busy into the next
+    # round's budget.
+    MAX_SEND_DEADLINE_S = DEFAULT_BASE_ROUND_TIMEOUT * 0.5
+
     def __init__(
         self,
         listen_addr: str,
         peers: Dict[str, str],
         deliver: Callable[[IbftMessage], None],
         logger=None,
+        *,
+        send_deadline_s: float = 3.0,
+        base_backoff_s: float = 0.05,
+        per_attempt_timeout_s: float = 2.0,
+        retry_seed: Optional[int] = None,
     ) -> None:
         self._listen_addr = listen_addr
         self._peers = dict(peers)
@@ -61,6 +85,13 @@ class GrpcTransport:
         self._stubs: Dict[str, grpc.aio.UnaryUnaryMultiCallable] = {}
         self._tasks: set = set()
         self.bound_port: Optional[int] = None
+        self.send_deadline_s = min(send_deadline_s, self.MAX_SEND_DEADLINE_S)
+        self.base_backoff_s = base_backoff_s
+        self.per_attempt_timeout_s = per_attempt_timeout_s
+        # Jitter stream: seedable so chaos tests replay exact backoff
+        # sequences; unseeded production transports de-synchronize
+        # naturally.
+        self._jitter = random.Random(retry_seed)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -135,11 +166,48 @@ class GrpcTransport:
             task.add_done_callback(self._tasks.discard)
 
     async def _send(self, name: str, stub, payload: bytes) -> None:
-        try:
-            await stub(payload, timeout=5.0)
-        except (grpc.aio.AioRpcError, asyncio.CancelledError) as err:
-            if self._log:
-                self._log.debug("grpc multicast to %s failed", name, err)
+        """One peer send: retry with jittered exponential backoff inside
+        ``send_deadline_s``.
+
+        Attempt k sleeps ``base_backoff_s * 2^k * uniform(0.5, 1.5)``
+        before retrying; the loop stops as soon as the remaining deadline
+        cannot cover the next backoff.  Failures stay fire-and-forget
+        (logged + counted, never raised): liveness is the protocol's job,
+        the retries only spare it a round change for a transient blip.
+        """
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.send_deadline_s
+        attempt = 0
+        while True:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                await stub(
+                    payload,
+                    timeout=min(self.per_attempt_timeout_s, remaining),
+                )
+                return
+            except asyncio.CancelledError:
+                return  # transport stopping: drop silently, never retry
+            except (grpc.aio.AioRpcError, grpc.RpcError) as err:
+                if self._log:
+                    self._log.debug(
+                        "grpc multicast attempt failed", name, attempt, err
+                    )
+            backoff = (
+                self.base_backoff_s
+                * (2.0**attempt)
+                * self._jitter.uniform(0.5, 1.5)
+            )
+            attempt += 1
+            if loop.time() + backoff >= deadline:
+                break
+            metrics.inc_counter(RETRY_KEY)
+            await asyncio.sleep(backoff)
+        metrics.inc_counter(SEND_FAILURE_KEY)
+        if self._log:
+            self._log.debug("grpc multicast gave up", name, attempt)
 
 
 def local_cluster_addresses(n: int) -> Sequence[str]:

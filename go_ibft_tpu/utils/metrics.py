@@ -22,6 +22,7 @@ _gauges: dict[tuple[str, ...], float] = {}
 _histograms: dict[tuple[str, ...], deque[float]] = defaultdict(
     lambda: deque(maxlen=_HISTOGRAM_WINDOW)
 )
+_counters: dict[tuple[str, ...], int] = defaultdict(int)
 _sink: Optional[Callable[[str, tuple[str, ...], float], None]] = None
 
 
@@ -59,6 +60,34 @@ def get_histogram(key: Sequence[str]) -> list[float]:
         return list(_histograms.get(tuple(key), ()))
 
 
+def inc_counter(key: Sequence[str], n: int = 1) -> int:
+    """Increment a monotonic counter (circuit-breaker transitions, quarantined
+    lanes, transport retries — the degraded-mode bookkeeping of
+    :mod:`go_ibft_tpu.verify` and :mod:`go_ibft_tpu.chaos`).  Returns the new
+    value."""
+    key = tuple(key)
+    with _lock:
+        _counters[key] += n
+        value = _counters[key]
+    if _sink is not None:
+        _sink("counter", key, float(value))
+    return value
+
+
+def get_counter(key: Sequence[str]) -> int:
+    with _lock:
+        return _counters.get(tuple(key), 0)
+
+
+def counters_snapshot(prefix: Sequence[str] = ()) -> dict[tuple[str, ...], int]:
+    """All counters under ``prefix`` (empty prefix = everything)."""
+    prefix = tuple(prefix)
+    with _lock:
+        return {
+            k: v for k, v in _counters.items() if k[: len(prefix)] == prefix
+        }
+
+
 def summarize(key: Sequence[str]) -> Optional[dict]:
     """Histogram summary ``{count, p50, mean, max}`` or ``None`` if empty.
 
@@ -83,3 +112,4 @@ def reset() -> None:
     with _lock:
         _gauges.clear()
         _histograms.clear()
+        _counters.clear()

@@ -13,6 +13,10 @@ stage 4):
 * :class:`AdaptiveBatchVerifier` — routes tiny batches to the host path
   and big ones to the device kernels (the dispatch-latency floor makes
   device batching a loss below ~a dozen lanes).
+* :class:`ResilientBatchVerifier` — the degraded-mode drain: quarantines
+  poison lanes by bisection and demotes a faulting device down the
+  ``device -> host (native) -> pure Python`` ladder via a
+  :class:`CircuitBreaker`, restoring after cooldown (docs/ROBUSTNESS.md).
 
 All return identical boolean masks for identical inputs — determinism
 across backends is part of the conformance suite.
@@ -22,15 +26,20 @@ from .batch import (
     AdaptiveBatchVerifier,
     DeviceBatchVerifier,
     HostBatchVerifier,
+    MalformedLaneError,
+    ResilientBatchVerifier,
     SIG_BYTES,
 )
-from .pipeline import PackCache, VerifyPipeline
+from .pipeline import CircuitBreaker, PackCache, VerifyPipeline
 
 __all__ = [
     "AdaptiveBatchVerifier",
+    "CircuitBreaker",
     "DeviceBatchVerifier",
     "HostBatchVerifier",
+    "MalformedLaneError",
     "PackCache",
+    "ResilientBatchVerifier",
     "VerifyPipeline",
     "SIG_BYTES",
 ]

@@ -38,11 +38,34 @@ from ..ops import quorum
 from ..ops import secp256k1 as sec
 from ..ops.fields import LIMB_BITS, LIMB_MASK
 from ..utils import metrics
-from .pipeline import PackCache, SenderPack, VerifyPipeline
+from .pipeline import CircuitBreaker, PackCache, SenderPack, VerifyPipeline
 
 SIG_BYTES = 65  # r(32) || s(32) || v(1)
 
 ADDRESS_BYTES = 20
+
+
+class MalformedLaneError(ValueError):
+    """A packer input lane has an invalid length, named by index.
+
+    The vectorized packers build whole-batch ``frombuffer`` views, so a
+    single wrong-length signature or address used to surface as an opaque
+    numpy reshape error (or worse, silently misaligned lanes).  Length
+    validation now runs up front and raises this instead — a ``ValueError``
+    subclass, so callers that caught the loop packers' errors still do —
+    carrying the offending lane so degraded-mode drains can quarantine
+    exactly that lane and verify the rest
+    (:class:`ResilientBatchVerifier`).
+    """
+
+    def __init__(self, lane: int, field: str, expected: int, got: int):
+        self.lane = lane
+        self.field = field
+        self.expected = expected
+        self.got = got
+        super().__init__(
+            f"lane {lane}: {field} must be {expected} bytes, got {got}"
+        )
 
 # Pad-to buckets: batch lanes, keccak blocks per message, validator-set size.
 # Every (lane, block, table) triple is a separate XLA program, and the lane x
@@ -86,8 +109,16 @@ class HostBatchVerifier:
     for BASELINE.md's >=30x target.
     """
 
-    def __init__(self, validators_for_height: ValidatorSource):
+    def __init__(
+        self,
+        validators_for_height: ValidatorSource,
+        recover_fn: Optional[Callable] = None,
+    ):
         self._validators = validators_for_height
+        # ``recover_fn`` overrides the ecrecover primitive: the degraded-
+        # mode ladder's bottom rung passes ``ecdsa.recover_pure`` so a
+        # crashing native library can be routed around entirely.
+        self._recover = recover_fn if recover_fn is not None else host_ecdsa.recover
 
     def _is_member(self, height: int, address: bytes) -> bool:
         return address in self._validators(height)
@@ -101,7 +132,7 @@ class HostBatchVerifier:
                 continue
             r, s, v = split_signature(msg.signature)
             digest = keccak256(msg.encode(include_signature=False))
-            pub = host_ecdsa.recover(digest, r, s, v)
+            pub = self._recover(digest, r, s, v)
             if pub is None:
                 continue
             out[i] = (
@@ -123,7 +154,7 @@ class HostBatchVerifier:
             if len(seal.signer) != ADDRESS_BYTES or len(seal.signature) != SIG_BYTES:
                 continue
             r, s, v = split_signature(seal.signature)
-            pub = host_ecdsa.recover(proposal_hash, r, s, v)
+            pub = self._recover(proposal_hash, r, s, v)
             if pub is None:
                 continue
             out[i] = (
@@ -216,14 +247,12 @@ def _split_signatures(
     Returns ``(r_words, s_words, v)`` with the words as ``(N, 8)`` uint32
     little-endian value words (the 32 big-endian bytes reversed and viewed
     as uint32) and ``v`` as ``(N,)`` int32.  One C-level join + one
-    ``frombuffer`` for the whole batch; raises on any wrong-length
-    signature, naming the lane.
+    ``frombuffer`` for the whole batch; raises :class:`MalformedLaneError`
+    on any wrong-length signature, naming the lane.
     """
     for i, sig in enumerate(sigs):
         if len(sig) != SIG_BYTES:
-            raise ValueError(
-                f"signature {i} must be {SIG_BYTES} bytes, got {len(sig)}"
-            )
+            raise MalformedLaneError(i, "signature", SIG_BYTES, len(sig))
     n = len(sigs)
     if n == 0:
         z = np.zeros((0, 8), dtype=np.uint32)
@@ -278,8 +307,9 @@ def pack_sender_batch(
     """Messages -> device-ready arrays for the sender-validity kernel.
 
     Returns ``(blocks, counts, r, s, v, senders, live)`` as numpy/jax
-    arrays padded to bucketed static shapes.  Callers must pre-filter
-    malformed messages (wrong sender/signature length).  ``payloads``
+    arrays padded to bucketed static shapes.  A lane with a wrong-length
+    sender or signature raises :class:`MalformedLaneError` naming the lane
+    (validated up front — never a numpy reshape crash).  ``payloads``
     overrides the per-message signed bytes (the oversize-payload path
     substitutes empty payloads for lanes whose digest is computed on host).
 
@@ -304,6 +334,14 @@ def pack_sender_batch(
     not a crash.
     """
     n = len(msgs)
+    # Length validation up front (the whole-batch frombuffer views below
+    # would otherwise die in an opaque numpy reshape): the error names the
+    # TRUE lane index so degraded-mode drains can quarantine exactly it.
+    for i, m in enumerate(msgs):
+        if len(m.signature) != SIG_BYTES:
+            raise MalformedLaneError(i, "signature", SIG_BYTES, len(m.signature))
+        if len(m.sender) != ADDRESS_BYTES:
+            raise MalformedLaneError(i, "sender", ADDRESS_BYTES, len(m.sender))
     bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
     nl = sec.FIELD.nlimbs
     r_limbs = np.zeros((bb, nl), dtype=np.int32)
@@ -376,8 +414,19 @@ def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_la
     Returns ``(hash_words, r, s, v, signers, live)``; the proposal hash is
     broadcast to every lane as little-endian value words.  Vectorized like
     :func:`pack_sender_batch`; an empty seal sequence returns a fully-dead
-    padded batch.
+    padded batch.  Lengths are validated up front
+    (:class:`MalformedLaneError` names the bad lane; a non-32-byte proposal
+    hash is a plain ``ValueError`` — it is batch-wide, not a lane).
     """
+    if len(proposal_hash) != 32:
+        raise ValueError(
+            f"proposal hash must be 32 bytes, got {len(proposal_hash)}"
+        )
+    for i, s in enumerate(seals):
+        if len(s.signature) != SIG_BYTES:
+            raise MalformedLaneError(i, "signature", SIG_BYTES, len(s.signature))
+        if len(s.signer) != ADDRESS_BYTES:
+            raise MalformedLaneError(i, "signer", ADDRESS_BYTES, len(s.signer))
     n = len(seals)
     bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
     hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
@@ -508,6 +557,15 @@ class DeviceBatchVerifier:
     def reset_pack_cache(self) -> None:
         """Engine hook: new sequence -> drop all cached packs."""
         self._pack_cache.clear()
+
+    def quarantine(self, msgs: Sequence[IbftMessage]) -> None:
+        """Degraded-mode hook: lanes condemned by a quarantining drain.
+
+        Evicts the lanes' cached packs so a corrected re-send (or a retry
+        after a transient device fault) re-packs from the live bytes
+        instead of being served the lane that was just condemned."""
+        for m in msgs:
+            self._pack_cache.evict(m)
 
     def warmup(
         self,
@@ -1051,6 +1109,163 @@ class DeviceBatchVerifier:
         return sender_mask, seal_mask
 
 
+QUARANTINED_LANES_KEY = ("go-ibft", "resilient", "quarantined_lanes")
+DRAIN_FAULTS_KEY = ("go-ibft", "resilient", "drain_faults")
+
+
+class ResilientBatchVerifier:
+    """Degraded-mode drain: quarantine poison lanes, demote dead rungs.
+
+    Implements the :class:`~go_ibft_tpu.core.backend.BatchVerifier`
+    protocol over a fastest-first ladder of rungs — by default
+    ``device -> host (native) -> pure Python`` — governed by a
+    :class:`~go_ibft_tpu.verify.pipeline.CircuitBreaker`:
+
+    * **Poison batches never propagate.**  A drain whose rung raises
+      (a device-side XLA ``RuntimeError``, a native verifier crash, a lane
+      whose packing blows up) is bisected: halves re-verify independently,
+      a single lane that still raises at this rung is retried one rung
+      down, and only a lane no rung can process is condemned (mask False).
+      :class:`MalformedLaneError` short-circuits the bisection — the
+      packer already named the lane, so it quarantines immediately and the
+      rest of the batch re-verifies in one piece.
+    * **Circuit breaker.**  ``k`` consecutive faulted drains at a rung
+      demote all traffic one rung down; after ``cooldown_s`` the breaker
+      probes the faster rung with one live drain and climbs back on
+      success.  Every transition is counted in
+      :mod:`go_ibft_tpu.utils.metrics` (``("go-ibft", "breaker", ...)``).
+    * **Quarantine eviction.**  Condemned sender lanes are reported to the
+      fast rung's ``quarantine`` hook (when present), which evicts their
+      :class:`~go_ibft_tpu.verify.pipeline.PackCache` entries so a
+      corrected re-send is never served a stale packed lane.
+
+    A drain therefore ALWAYS returns a verdict per lane and never raises —
+    the liveness contract the chaos suites pin (ISSUE 3).
+    """
+
+    def __init__(
+        self,
+        device,
+        host: Optional[HostBatchVerifier] = None,
+        python: Optional[HostBatchVerifier] = None,
+        *,
+        validators_for_height: Optional[ValidatorSource] = None,
+        breaker: Optional["CircuitBreaker"] = None,
+    ):
+        if host is None or python is None:
+            if validators_for_height is None:
+                raise ValueError(
+                    "validators_for_height required when host/python rungs "
+                    "are not supplied"
+                )
+        if host is None:
+            host = HostBatchVerifier(validators_for_height)
+        if python is None:
+            python = HostBatchVerifier(
+                validators_for_height or host._validators,
+                recover_fn=host_ecdsa.recover_pure,
+            )
+        self._rungs = [("device", device), ("host", host), ("python", python)]
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            tuple(name for name, _ in self._rungs)
+        )
+        self.device = device
+        self.host = host
+
+    # -- engine hooks (forwarded to the fast rung when it has them) ------
+
+    def warmup(self, **kw) -> None:
+        if hasattr(self.device, "warmup"):
+            self.device.warmup(**kw)
+
+    def note_round(self, round_: int) -> None:
+        if hasattr(self.device, "note_round"):
+            self.device.note_round(round_)
+
+    def reset_pack_cache(self) -> None:
+        if hasattr(self.device, "reset_pack_cache"):
+            self.device.reset_pack_cache()
+
+    # -- BatchVerifier ---------------------------------------------------
+
+    def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
+        msgs = list(msgs)
+        return self._drain(
+            msgs,
+            lambda rung, idxs: rung.verify_senders([msgs[i] for i in idxs]),
+            quarantinable=msgs,
+        )
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        seals = list(seals)
+        return self._drain(
+            seals,
+            lambda rung, idxs: rung.verify_committed_seals(
+                proposal_hash, [seals[i] for i in idxs], height
+            ),
+        )
+
+    # -- drain machinery -------------------------------------------------
+
+    def _drain(self, items, run, quarantinable=None) -> np.ndarray:
+        n = len(items)
+        out = np.zeros(n, dtype=bool)
+        if n == 0:
+            return out
+        level, probe = self.breaker.acquire()
+        quarantined: List[int] = []
+        faulted = [False]
+        self._verify(level, list(range(n)), run, out, quarantined, faulted)
+        if faulted[0]:
+            metrics.inc_counter(DRAIN_FAULTS_KEY)
+            self.breaker.record_fault(level)
+        else:
+            self.breaker.record_success(level)
+        if quarantined:
+            metrics.inc_counter(QUARANTINED_LANES_KEY, len(quarantined))
+            if quarantinable is not None and hasattr(self.device, "quarantine"):
+                self.device.quarantine([quarantinable[i] for i in quarantined])
+        return out
+
+    def _verify(self, level, idxs, run, out, quarantined, faulted) -> None:
+        """Verify ``idxs`` at rung ``level``, bisecting around failures.
+
+        Writes verdicts into ``out``; lanes no rung can process land in
+        ``quarantined`` (verdict stays False).  ``faulted`` records whether
+        THIS drain hit any non-malformed rung failure — one breaker fault
+        per drain, no matter how many bisection steps it took.
+        """
+        while idxs:
+            try:
+                mask = np.asarray(run(self._rungs[level][1], idxs), dtype=bool)
+                out[np.asarray(idxs)] = mask[: len(idxs)]
+                return
+            except MalformedLaneError as err:
+                # The packer named the lane: condemn it, retry the rest in
+                # one piece (no bisection needed, no breaker fault — the
+                # rung is healthy, the input was not).
+                if not 0 <= err.lane < len(idxs):
+                    quarantined.extend(idxs)
+                    return
+                quarantined.append(idxs[err.lane])
+                idxs = idxs[: err.lane] + idxs[err.lane + 1 :]
+            except Exception:
+                faulted[0] = True
+                if len(idxs) == 1:
+                    if level + 1 < len(self._rungs):
+                        self._verify(
+                            level + 1, idxs, run, out, quarantined, faulted
+                        )
+                    else:
+                        quarantined.extend(idxs)
+                    return
+                mid = len(idxs) // 2
+                self._verify(level, idxs[:mid], run, out, quarantined, faulted)
+                idxs = idxs[mid:]
+
+
 class AdaptiveBatchVerifier:
     """Host/device router: tiny batches on host, large ones on device.
 
@@ -1067,6 +1282,15 @@ class AdaptiveBatchVerifier:
     the host fallback computes the voting-power quorum with exact Python
     ints, mirroring ops/quorum.py ``power_reduce`` semantics (distinct
     validators counted once).
+
+    Device-routed drains ride a :class:`ResilientBatchVerifier` ladder: a
+    poison batch (device raising mid-dispatch, a lane whose packing blows
+    up) is bisected/quarantined instead of crashing the drain, and the
+    shared circuit breaker demotes to the host rungs after repeated device
+    faults (restoring after cooldown).  The fused certify paths fall back
+    to the exact host-int route on any device exception — counted under
+    ``("go-ibft", "resilient", "certify_fallback")`` — so a consensus
+    phase never loses its verdict to a device fault.
     """
 
     def __init__(
@@ -1075,6 +1299,7 @@ class AdaptiveBatchVerifier:
         cutover_lanes: Optional[int] = None,
         device: Optional[DeviceBatchVerifier] = None,
         host: Optional[HostBatchVerifier] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         from ..utils import calibration
 
@@ -1090,6 +1315,13 @@ class AdaptiveBatchVerifier:
         self.cutover = cutover_lanes
         self.device = device if device is not None else DeviceBatchVerifier(validators_for_height)
         self.host = host if host is not None else HostBatchVerifier(validators_for_height)
+        self._resilient = ResilientBatchVerifier(
+            self.device,
+            host=self.host,
+            validators_for_height=validators_for_height,
+            breaker=breaker,
+        )
+        self.breaker = self._resilient.breaker
 
     def warmup(self, **kw) -> None:
         self.device.warmup(**kw)
@@ -1131,14 +1363,16 @@ class AdaptiveBatchVerifier:
     def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
         if self._host_sized(len(msgs)):
             return self.host.verify_senders(msgs)
-        return self.device.verify_senders(msgs)
+        # Device route rides the degradation ladder: poison batches
+        # quarantine instead of raising, device faults demote to host.
+        return self._resilient.verify_senders(msgs)
 
     def verify_committed_seals(
         self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
     ) -> np.ndarray:
         if self._host_sized(len(seals)):
             return self.host.verify_committed_seals(proposal_hash, seals, height)
-        return self.device.verify_committed_seals(proposal_hash, seals, height)
+        return self._resilient.verify_committed_seals(proposal_hash, seals, height)
 
     # -- FusedBatchVerifier ---------------------------------------------
 
@@ -1156,6 +1390,27 @@ class AdaptiveBatchVerifier:
             and self.device.supports_fused(height)
         )
 
+    def _breaker_gate(self) -> Tuple[bool, Optional[int]]:
+        """Consult the breaker before a fused device dispatch.
+
+        Returns ``(use_device, acquired_level)``: when the ladder is
+        demoted the fused dispatch is suppressed and the caller's
+        fallback serves the call.  An acquisition that does not end up
+        running the device MUST be released with
+        ``breaker.abort_probe(acquired_level)`` once the call completes —
+        never answered with success for a rung that did not run (the
+        ladder would restore on no evidence), and a pending probe must
+        not leak (``_probing`` would wedge and no probe would ever be
+        offered again)."""
+        level, _probe = self.breaker.acquire()
+        if level == 0:
+            return True, None
+        return False, level
+
+    def _device_faulted(self) -> None:
+        metrics.inc_counter(("go-ibft", "resilient", "certify_fallback"))
+        self.breaker.record_fault(0)
+
     def _chunked_device(self, n: int, height: int) -> bool:
         # No supports_fused gate: the chunked route never touches the
         # device quorum pack (mask from verify_*, quorum from host ints),
@@ -1165,12 +1420,34 @@ class AdaptiveBatchVerifier:
     def certify_senders(
         self, msgs: Sequence[IbftMessage], height: int, threshold: Optional[int] = None
     ) -> Tuple[np.ndarray, bool]:
-        if self._route_device(len(msgs), height):
-            return self.device.certify_senders(msgs, height, threshold)
-        if self._chunked_device(len(msgs), height):
-            # Oversize flood: crypto stays on device (full-bucket chunks),
-            # only the quorum reduction moves to exact host ints.
-            mask = self.device.verify_senders(msgs)
+        fallback_level = None
+        device_route = self._route_device(len(msgs), height)
+        if device_route:
+            use_device, fallback_level = self._breaker_gate()
+            if use_device:
+                try:
+                    result = self.device.certify_senders(msgs, height, threshold)
+                    self.breaker.record_success(0)
+                    return result
+                except MalformedLaneError:
+                    # Input poison, not a device fault: the rung is
+                    # healthy (same rule as the resilient drain), so no
+                    # breaker fault — a pending probe is released, not
+                    # failed, and the ladder-aware fallback below
+                    # quarantines the lane.
+                    self.breaker.abort_probe(0)
+                except Exception:
+                    # Device fault mid-phase: the fallback below still
+                    # produces the verdict (no exception escapes a
+                    # certify call).
+                    self._device_faulted()
+        if device_route or self._chunked_device(len(msgs), height):
+            # Ladder-aware fallback: quarantines poison lanes, respects
+            # the breaker's demotion, never raises, and carries its own
+            # breaker accounting (oversize floods keep crypto on device
+            # in full-bucket chunks; only the quorum reduction moves to
+            # exact host ints).
+            mask = self._resilient.verify_senders(msgs)
         else:
             mask = self.host.verify_senders(msgs)
         # Same height gate as the device path (certify is per-view).
@@ -1178,6 +1455,10 @@ class AdaptiveBatchVerifier:
             if m.view is None or m.view.height != height:
                 mask[i] = False
         valid = [m.sender for m, ok in zip(msgs, mask) if ok]
+        if fallback_level is not None:
+            # The gate's acquisition did not run the device: release it
+            # (a pending probe must neither leak nor count as evidence).
+            self.breaker.abort_probe(fallback_level)
         return mask, self._host_reached(valid, height, threshold)
 
     def certify_seals(
@@ -1187,13 +1468,30 @@ class AdaptiveBatchVerifier:
         height: int,
         threshold: Optional[int] = None,
     ) -> Tuple[np.ndarray, bool]:
-        if self._route_device(len(seals), height):
-            return self.device.certify_seals(proposal_hash, seals, height, threshold)
-        if self._chunked_device(len(seals), height):
-            mask = self.device.verify_committed_seals(proposal_hash, seals, height)
+        fallback_level = None
+        device_route = self._route_device(len(seals), height)
+        if device_route:
+            use_device, fallback_level = self._breaker_gate()
+            if use_device:
+                try:
+                    result = self.device.certify_seals(
+                        proposal_hash, seals, height, threshold
+                    )
+                    self.breaker.record_success(0)
+                    return result
+                except MalformedLaneError:
+                    self.breaker.abort_probe(0)
+                except Exception:
+                    self._device_faulted()
+        if device_route or self._chunked_device(len(seals), height):
+            mask = self._resilient.verify_committed_seals(
+                proposal_hash, seals, height
+            )
         else:
             mask = self.host.verify_committed_seals(proposal_hash, seals, height)
         valid = [s.signer for s, ok in zip(seals, mask) if ok]
+        if fallback_level is not None:
+            self.breaker.abort_probe(fallback_level)
         return mask, self._host_reached(valid, height, threshold)
 
     def certify_round(
@@ -1204,14 +1502,26 @@ class AdaptiveBatchVerifier:
         height: int,
         prepare_threshold: Optional[int] = None,
     ) -> Tuple[np.ndarray, bool, np.ndarray, bool]:
+        fallback_level = None
         if (
             self._route_device(max(len(msgs), len(seals)), height)
             and msgs
             and seals
         ):
-            return self.device.certify_round(
-                msgs, proposal_hash, seals, height, prepare_threshold
-            )
+            use_device, fallback_level = self._breaker_gate()
+            if use_device:
+                try:
+                    result = self.device.certify_round(
+                        msgs, proposal_hash, seals, height, prepare_threshold
+                    )
+                    self.breaker.record_success(0)
+                    return result
+                except MalformedLaneError:
+                    self.breaker.abort_probe(0)
+                except Exception:
+                    # Fall through to the per-phase routes, which carry
+                    # their own breaker accounting and ladder fallbacks.
+                    self._device_faulted()
         if (
             msgs
             and seals
@@ -1225,22 +1535,34 @@ class AdaptiveBatchVerifier:
             # Oversize round: BOTH phases drain through one device pipeline
             # (seal packing overlaps the tail envelope dispatches); quorum
             # reduces on exact host ints like every chunked route.
-            sender_mask, seal_mask = self.device.verify_round_chunked(
-                msgs, proposal_hash, seals, height
-            )
-            p_ok = self._host_reached(
-                [m.sender for m, ok in zip(msgs, sender_mask) if ok],
-                height,
-                prepare_threshold,
-            )
-            s_ok = self._host_reached(
-                [s.signer for s, ok in zip(seals, seal_mask) if ok],
-                height,
-                None,
-            )
-            return sender_mask, p_ok, seal_mask, s_ok
+            try:
+                sender_mask, seal_mask = self.device.verify_round_chunked(
+                    msgs, proposal_hash, seals, height
+                )
+            except Exception:
+                # Cross-phase pipeline faulted: the per-phase resilient
+                # drains below still produce both verdicts.
+                self._device_faulted()
+            else:
+                p_ok = self._host_reached(
+                    [m.sender for m, ok in zip(msgs, sender_mask) if ok],
+                    height,
+                    prepare_threshold,
+                )
+                s_ok = self._host_reached(
+                    [s.signer for s, ok in zip(seals, seal_mask) if ok],
+                    height,
+                    None,
+                )
+                if fallback_level is not None:
+                    self.breaker.abort_probe(fallback_level)
+                return sender_mask, p_ok, seal_mask, s_ok
         sender_mask, p_ok = self.certify_senders(
             msgs, height, threshold=prepare_threshold
         )
         seal_mask, s_ok = self.certify_seals(proposal_hash, seals, height)
+        if fallback_level is not None:
+            # Released AFTER the per-phase routes: their own gates see the
+            # probe as still pending and cannot double-acquire it.
+            self.breaker.abort_probe(fallback_level)
         return sender_mask, p_ok, seal_mask, s_ok

@@ -56,6 +56,9 @@ __all__ = [
     "PACK_MS_KEY",
     "READBACK_WAIT_MS_KEY",
     "OVERLAP_EFFICIENCY_KEY",
+    "BREAKER_LEVEL_KEY",
+    "BREAKER_TRANSITIONS_KEY",
+    "CircuitBreaker",
     "PipelineReport",
     "VerifyPipeline",
     "SenderPack",
@@ -68,6 +71,129 @@ __all__ = [
 PACK_MS_KEY = ("go-ibft", "pipeline", "pack_ms")
 READBACK_WAIT_MS_KEY = ("go-ibft", "pipeline", "readback_wait_ms")
 OVERLAP_EFFICIENCY_KEY = ("go-ibft", "pipeline", "overlap_efficiency")
+
+# Degradation-ladder metric keys: the breaker's active level as a gauge and
+# every transition as a histogram sample (value = the level transitioned TO),
+# so ``metrics.summarize(BREAKER_TRANSITIONS_KEY)`` shows transition counts
+# without a scrape sink.  Per-edge counters ride
+# ``("go-ibft", "breaker", <demote|restore|probe|probe_failed>)``.
+BREAKER_LEVEL_KEY = ("go-ibft", "breaker", "level")
+BREAKER_TRANSITIONS_KEY = ("go-ibft", "breaker", "transitions")
+
+
+class CircuitBreaker:
+    """K-consecutive-fault demotion ladder with cooldown re-probe.
+
+    ``levels`` names the rungs fastest-first (e.g. ``("device", "host",
+    "python")``); traffic starts at level 0.  After ``k`` consecutive
+    recorded faults at the active level the breaker demotes one rung; after
+    ``cooldown_s`` seconds at a demoted level :meth:`acquire` offers the
+    next-faster rung once as a *probe* — a successful probe restores one
+    rung, a failed probe restarts the cooldown.  Restoration is therefore
+    stepwise: a ladder that fell two rungs climbs back one cooldown at a
+    time, each step proven by live traffic.
+
+    Thread-safe; ``clock`` is injectable so tests control the cooldown.
+    Every transition is counted in :mod:`go_ibft_tpu.utils.metrics`.
+    """
+
+    def __init__(
+        self,
+        levels: Sequence[str] = ("device", "host", "python"),
+        *,
+        k: int = 3,
+        cooldown_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not levels:
+            raise ValueError("breaker needs at least one level")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.levels = tuple(levels)
+        self.k = k
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = 0
+        self._faults = 0
+        self._demoted_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    @property
+    def level_name(self) -> str:
+        return self.levels[self.level]
+
+    def acquire(self) -> Tuple[int, bool]:
+        """Pick the level for one drain: ``(level, is_probe)``.
+
+        At a demoted level past its cooldown, returns the next-faster level
+        with ``is_probe=True`` (exactly one in-flight probe at a time; the
+        caller MUST answer with :meth:`record_success` or
+        :meth:`record_fault` for that level).
+        """
+        with self._lock:
+            if (
+                self._level > 0
+                and not self._probing
+                and self._demoted_at is not None
+                and self._clock() - self._demoted_at >= self.cooldown_s
+            ):
+                self._probing = True
+                metrics.inc_counter(("go-ibft", "breaker", "probe"))
+                return self._level - 1, True
+            return self._level, False
+
+    def record_success(self, level: int) -> None:
+        """A drain at ``level`` completed without a fault."""
+        with self._lock:
+            if self._probing and level == self._level - 1:
+                self._probing = False
+                self._transition(level, "restore")
+            elif level == self._level:
+                self._faults = 0
+
+    def abort_probe(self, level: int) -> None:
+        """Release a probe whose drain never exercised the probed rung
+        (input poison aborted it pre-dispatch, or the work was routed to a
+        different rung): the ladder stays demoted, no fault is recorded,
+        and — the cooldown having already elapsed — the next drain is
+        offered a fresh probe.  Recording success instead would restore
+        the ladder on no evidence; recording a fault would punish a rung
+        that never ran.  No-op unless ``level`` is the pending probe."""
+        with self._lock:
+            if self._probing and level == self._level - 1:
+                self._probing = False
+
+    def record_fault(self, level: int) -> bool:
+        """A drain at ``level`` faulted; returns True when this demoted."""
+        with self._lock:
+            if self._probing and level == self._level - 1:
+                # Probe failed: stay demoted, restart the cooldown clock.
+                self._probing = False
+                self._demoted_at = self._clock()
+                metrics.inc_counter(("go-ibft", "breaker", "probe_failed"))
+                return False
+            if level != self._level:
+                return False
+            self._faults += 1
+            if self._faults >= self.k and self._level + 1 < len(self.levels):
+                self._transition(self._level + 1, "demote")
+                return True
+            return False
+
+    def _transition(self, new_level: int, kind: str) -> None:
+        # Callers hold self._lock.
+        self._level = new_level
+        self._faults = 0
+        self._demoted_at = self._clock() if new_level > 0 else None
+        metrics.inc_counter(("go-ibft", "breaker", kind))
+        metrics.observe(BREAKER_TRANSITIONS_KEY, float(new_level))
+        metrics.set_gauge(BREAKER_LEVEL_KEY, float(new_level))
 
 
 def observe_overlap_efficiency(serial_s: float, pipelined_s: float) -> float:
@@ -264,6 +390,16 @@ class PackCache:
             self._index[mid] = self._round
             self._count += 1
             self._evict()
+
+    def evict(self, msg) -> None:
+        """Drop a message's cached pack (degraded-mode quarantine hook).
+
+        A quarantined lane's pack must not outlive the quarantine: if the
+        sender corrects and re-sends, the verifier must re-pack from the
+        fresh bytes rather than be served the lane that was just condemned.
+        No-op for messages never cached."""
+        with self._lock:
+            self._remove(id(msg))
 
     # -- internals ------------------------------------------------------
 

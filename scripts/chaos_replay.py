@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Replay a chaos-soak schedule from its seed.
+
+A failing chaos test prints one artifact line::
+
+    CHAOS-REPLAY seed=N schedule=<digest> config={...}
+
+Re-run the exact scenario with::
+
+    python scripts/chaos_replay.py --seed N [--heights 5] [--nodes 6]
+
+The injector is rebuilt from the seed (and optionally a config JSON copied
+off the artifact line), the soak cluster re-runs the same deterministic
+fault schedule, and the script prints per-height progress plus the final
+schedule digest so you can confirm you replayed the right run.  Exit code
+0 = every height finalized; 1 = the failure reproduced.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from go_ibft_tpu.chaos import (  # noqa: E402
+    ChaoticDeliver,
+    FaultConfig,
+    FaultInjector,
+)
+from go_ibft_tpu.core import IBFT, BatchingIngress  # noqa: E402
+from go_ibft_tpu.crypto import PrivateKey  # noqa: E402
+from go_ibft_tpu.crypto.backend import ECDSABackend  # noqa: E402
+from go_ibft_tpu.utils import metrics  # noqa: E402
+from go_ibft_tpu.verify import (  # noqa: E402
+    HostBatchVerifier,
+    ResilientBatchVerifier,
+)
+
+# Default config mirrors tests/test_chaos.py::_SOAK_CFG — override with
+# --config to replay a non-default schedule from an artifact line.
+DEFAULT_CONFIG = dict(
+    drop_rate=0.03,
+    delay_rate=0.3,
+    max_delay_s=0.01,
+    reorder_rate=0.05,
+    duplicate_rate=0.05,
+    corrupt_rate=0.02,
+)
+
+
+class _Log:
+    def info(self, *a):
+        pass
+
+    debug = info
+
+    def error(self, msg, *a):
+        print(f"ERROR: {msg} {a}", file=sys.stderr)
+
+
+async def replay(seed: int, heights: int, n_nodes: int, config: FaultConfig) -> int:
+    injector = FaultInjector(seed, config)
+    print(injector.replay_line(), flush=True)
+
+    keys = [PrivateKey.from_seed(b"chaos-%d" % i) for i in range(n_nodes)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    gates = []
+
+    class _T:
+        def multicast(self, message):
+            for gate in gates:
+                gate(message)
+
+    nodes = []
+    for i, key in enumerate(keys):
+        core = IBFT(
+            _Log(),
+            ECDSABackend(key, src),
+            _T(),
+            batch_verifier=ResilientBatchVerifier(
+                HostBatchVerifier(src), validators_for_height=src
+            ),
+        )
+        core.set_base_round_timeout(1.0)
+        ingress = BatchingIngress(core.add_messages)
+        gates.append(ChaoticDeliver(ingress.submit, injector, f"deliver:{i}"))
+        nodes.append((core, ingress))
+
+    failed = 0
+    try:
+        for h in range(1, heights + 1):
+            t0 = time.monotonic()
+            tasks = [
+                asyncio.create_task(core.run_sequence(h)) for core, _ in nodes
+            ]
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*tasks), timeout=75.0
+                )
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                for task in tasks:
+                    if not task.done():
+                        task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+            finalized = sum(
+                1 for core, _ in nodes if len(core.backend.inserted) >= h
+            )
+            print(
+                f"height {h}: {finalized}/{n_nodes} finalized "
+                f"in {time.monotonic() - t0:.1f}s",
+                flush=True,
+            )
+            if finalized == 0:
+                failed = 1
+                break
+            donor = next(
+                core
+                for core, _ in nodes
+                if len(core.backend.inserted) >= h
+            )
+            for core, _ in nodes:  # block-sync stragglers (embedder's job)
+                if len(core.backend.inserted) < h:
+                    core.backend.inserted.append(donor.backend.inserted[h - 1])
+    finally:
+        for core, ingress in nodes:
+            ingress.close()
+            core.messages.close()
+        await asyncio.sleep(0.05)
+
+    chaos = metrics.counters_snapshot(("go-ibft", "chaos"))
+    print("injected:", {k[-1]: v for k, v in sorted(chaos.items())}, flush=True)
+    return failed
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, required=True)
+    parser.add_argument("--heights", type=int, default=5)
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument(
+        "--config",
+        type=str,
+        default=None,
+        help="JSON FaultConfig overrides (copy off the CHAOS-REPLAY line)",
+    )
+    args = parser.parse_args()
+    overrides = json.loads(args.config) if args.config else {}
+    config = FaultConfig(**{**DEFAULT_CONFIG, **overrides})
+    return asyncio.run(replay(args.seed, args.heights, args.nodes, config))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,7 @@ _FIVE_CONFIG_KEYS = (
     "ecdsa_1000v_10h_pipelined_throughput",
     "bls_aggregate_verify_p50_100v",
     "byzantine_300v_30pct_prepare_commit_p50",
+    "chaos_degraded_overhead_100v",
     bench.headline_metric(True),
 )
 

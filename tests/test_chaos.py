@@ -1,0 +1,375 @@
+"""Chaos-injection harness: determinism, wrappers, and the seeded soak.
+
+The determinism contract (ISSUE 3 acceptance): same seed => byte-identical
+fault schedule; any chaos failure prints a CHAOS-REPLAY line carrying the
+seed so ``scripts/chaos_replay.py --seed N`` reproduces it exactly.
+
+The soak: a 6-node real-crypto cluster runs heights under a randomized
+drop/delay/corrupt/duplicate/reorder schedule and still finalizes every
+height — liveness under loss, the property BFT deployments live or die by.
+The tier-1 smoke runs one seed over 2 heights; the slow variant runs the
+full 5 heights over multiple seeds.
+"""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.chaos import (
+    ChaoticDeliver,
+    ChaoticTransport,
+    ChaoticVerifier,
+    FaultConfig,
+    FaultInjector,
+    InjectedDeviceError,
+    corrupt_message,
+    replay_on_failure,
+)
+from go_ibft_tpu.core import IBFT, BatchingIngress
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend
+from go_ibft_tpu.messages.wire import (
+    IbftMessage,
+    MessageType,
+    PrepareMessage,
+    View,
+)
+from go_ibft_tpu.utils import metrics
+from go_ibft_tpu.verify import HostBatchVerifier, ResilientBatchVerifier
+
+from harness import NullLogger
+
+_CFG = FaultConfig(
+    drop_rate=0.3,
+    delay_rate=0.3,
+    max_delay_s=0.01,
+    reorder_rate=0.2,
+    duplicate_rate=0.2,
+    corrupt_rate=0.2,
+    slow_verify_rate=0.1,
+    slow_verify_s=0.001,
+    device_error_rate=0.2,
+)
+
+
+def _msg(round_=0) -> IbftMessage:
+    return IbftMessage(
+        view=View(height=1, round=round_),
+        sender=b"s" * 20,
+        signature=b"\x01" * 65,
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=b"\x22" * 32),
+    )
+
+
+# -- determinism contract ----------------------------------------------------
+
+
+def test_same_seed_byte_identical_schedule():
+    a = FaultInjector(42, _CFG)
+    b = FaultInjector(42, _CFG)
+    for site in ("deliver:0", "deliver:5", "transport"):
+        assert a.schedule_bytes(site, 200) == b.schedule_bytes(site, 200)
+    for site in ("verify:0", "verify:3"):
+        assert a.schedule_bytes(site, 200, kind="verify") == b.schedule_bytes(
+            site, 200, kind="verify"
+        )
+    assert a.schedule_digest() == b.schedule_digest()
+
+
+def test_different_seed_different_schedule():
+    a = FaultInjector(42, _CFG)
+    b = FaultInjector(43, _CFG)
+    assert a.schedule_bytes("deliver:0", 200) != b.schedule_bytes(
+        "deliver:0", 200
+    )
+    assert a.schedule_digest() != b.schedule_digest()
+
+
+def test_live_draws_match_schedule_and_are_per_site():
+    """Live decisions replay the schedule exactly, and each site's stream
+    is independent of how other sites interleave."""
+    a = FaultInjector(7, _CFG)
+    b = FaultInjector(7, _CFG)
+    # interleave site draws differently on b; per-site sequences must match
+    seq_a = [a.transport_fault("deliver:1") for _ in range(32)]
+    _ = [b.transport_fault("deliver:2") for _ in range(17)]  # noise site
+    seq_b = [b.transport_fault("deliver:1") for _ in range(32)]
+    assert seq_a == seq_b
+    # and schedule_bytes derives the same stream without disturbing live
+    # draws (a's stream already advanced 32 events)
+    assert a.schedule_bytes("deliver:1", 32) == b.schedule_bytes("deliver:1", 32)
+    assert a.transport_fault("deliver:1") == b.transport_fault("deliver:1")
+
+
+def test_device_error_burst_is_deterministic():
+    inj = FaultInjector(3, FaultConfig(device_error_burst=2))
+    faults = [inj.verify_fault("verify:x") for _ in range(5)]
+    assert [f.device_error for f in faults] == [True, True, False, False, False]
+
+
+def test_replay_on_failure_prints_seed(capsys):
+    inj = FaultInjector(1234, _CFG)
+    with pytest.raises(AssertionError):
+        with replay_on_failure(inj):
+            assert False, "boom"
+    out = capsys.readouterr().out
+    assert "CHAOS-REPLAY" in out
+    assert "seed=1234" in out
+    assert inj.schedule_digest() in out
+
+
+# -- wrappers ----------------------------------------------------------------
+
+
+def test_chaotic_deliver_drops_everything_at_rate_one():
+    metrics.reset()
+    inj = FaultInjector(1, FaultConfig(drop_rate=1.0))
+    got = []
+    deliver = ChaoticDeliver(got.append, inj, "deliver:t")
+    for _ in range(10):
+        deliver(_msg())
+    assert got == []
+    assert metrics.get_counter(("go-ibft", "chaos", "dropped")) == 10
+
+
+def test_chaotic_deliver_duplicates():
+    inj = FaultInjector(1, FaultConfig(duplicate_rate=1.0))
+    got = []
+    deliver = ChaoticDeliver(got.append, inj, "deliver:t")
+    deliver(_msg())
+    assert len(got) == 2
+
+
+def test_corrupt_message_mutates_copy_not_original():
+    original = _msg()
+    encoded_before = original.encode()
+    mutated = corrupt_message(original, bit=13)
+    assert original.encode() == encoded_before  # original untouched
+    assert mutated is None or mutated.encode() != encoded_before
+
+
+def test_chaotic_transport_wraps_multicast():
+    class _Inner:
+        def __init__(self):
+            self.sent = []
+
+        def multicast(self, message):
+            self.sent.append(message)
+
+    inner = _Inner()
+    t = ChaoticTransport(inner, FaultInjector(2, FaultConfig()), "transport")
+    t.multicast(_msg())
+    assert len(inner.sent) == 1  # zero-rate config: pure pass-through
+    assert t.inner is inner
+
+
+def test_chaotic_verifier_raises_injected_device_error():
+    src = ECDSABackend.static_validators({b"a" * 20: 1})
+    inj = FaultInjector(5, FaultConfig(device_error_rate=1.0))
+    v = ChaoticVerifier(HostBatchVerifier(src), inj, "verify:t")
+    with pytest.raises(InjectedDeviceError) as err:
+        v.verify_senders([_msg()])
+    assert isinstance(err.value, RuntimeError)  # the XLA-shaped failure
+    assert "seed=5" in str(err.value)
+
+
+# -- seeded chaos soak -------------------------------------------------------
+
+
+# Soak rates respect the quorum's fault budget: 6 nodes tolerate f=1, so a
+# phase survives at most ONE effective loss per receiver — drops and
+# corruptions (a corrupted envelope is rejected at ingress = an effective
+# drop) must stay well below the ~1/6 per-delivery budget or NO round can
+# complete and the test measures luck, not robustness.  ~5% combined loss
+# makes most rounds succeed while every height still sees real faults.
+_SOAK_CFG = FaultConfig(
+    drop_rate=0.03,
+    delay_rate=0.3,
+    max_delay_s=0.01,
+    reorder_rate=0.05,
+    duplicate_rate=0.05,
+    corrupt_rate=0.02,
+)
+
+
+class _ChaosCluster:
+    """6-node real-crypto loopback cluster with chaotic per-receiver
+    delivery (drops, delays, reordering, duplication, wire bit-flips).
+
+    Height driving mirrors the reference's awaitNCompletions +
+    forceShutdown pattern (core/mock_test.go; ``harness.Cluster.
+    run_height_quorum``): consensus liveness means the HEIGHT finalizes
+    within the deadline — a node that was stranded by a dropped COMMIT
+    after everyone else already finalized cannot finish that instance by
+    protocol (its peers have left the height), and in production recovers
+    by block sync, which is the embedder's job in the reference too.  Here
+    the straggler is cancelled and syncs the finalized block from a peer;
+    the soak asserts every height finalized through consensus and counts
+    how often sync was needed.
+    """
+
+    def __init__(self, n: int, injector: FaultInjector):
+        keys = [PrivateKey.from_seed(b"chaos-%d" % i) for i in range(n)]
+        self._powers = {k.address: 1 for k in keys}
+        src = ECDSABackend.static_validators(self._powers)
+        self.nodes = []
+        self._gates = []
+        self.synced_heights = 0
+        cluster = self
+
+        class _T:
+            def multicast(self, message):
+                for gate in cluster._gates:
+                    gate(message)
+
+        for i, key in enumerate(keys):
+            core = IBFT(
+                NullLogger(),
+                ECDSABackend(key, src),
+                _T(),
+                batch_verifier=ResilientBatchVerifier(
+                    HostBatchVerifier(src), validators_for_height=src
+                ),
+            )
+            # Short rounds so a lossy round retries quickly: the timeout
+            # grows 2^round, so a tall base eats the height deadline after
+            # two failed rounds (phases complete in ~10-30 ms here).
+            core.set_base_round_timeout(1.0)
+            ingress = BatchingIngress(core.add_messages)
+            self._gates.append(
+                ChaoticDeliver(ingress.submit, injector, f"deliver:{i}")
+            )
+            self.nodes.append((core, ingress))
+
+    async def run_height(self, h: int, timeout: float = 60.0):
+        tasks = [
+            asyncio.create_task(core.run_sequence(h))
+            for core, _ in self.nodes
+        ]
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        quorum = 5  # calculate_quorum(6)
+        pending = set(tasks)
+        last_progress = loop.time()
+        # Liveness: wait while consensus can still make progress.  Below a
+        # quorum of completions the remaining nodes can still finalize each
+        # other (round changes re-sync them), so keep waiting; once a
+        # quorum has finished, the stragglers' peers have left the height
+        # and only block sync can save them — one short grace, then stop.
+        while pending:
+            now = loop.time()
+            if now >= deadline:
+                break
+            done, pending = await asyncio.wait(
+                pending,
+                timeout=min(deadline - now, 0.5),
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if done:
+                last_progress = loop.time()
+            completed = len(tasks) - len(pending)
+            if completed >= quorum:
+                if pending:
+                    _, pending = await asyncio.wait(pending, timeout=1.0)
+                break
+            # Sub-quorum finalization wedge (e.g. 4 done, 2 stranded on a
+            # dropped COMMIT): no further completion is possible, detected
+            # as a long stall after first progress.
+            if completed >= 1 and loop.time() - last_progress > 10.0:
+                break
+        finalized = [
+            (core, ingress)
+            for core, ingress in self.nodes
+            if len(core.backend.inserted) >= h
+        ]
+        assert finalized, f"no node finalized height {h} within {timeout}s"
+        for task in tasks:
+            if not task.done():
+                task.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        donor = finalized[0][0]
+        for core, _ in self.nodes:
+            if len(core.backend.inserted) < h:  # stranded: block sync
+                core.backend.inserted.append(donor.backend.inserted[h - 1])
+                self.synced_heights += 1
+
+    def close(self):
+        for core, ingress in self.nodes:
+            ingress.close()
+            core.messages.close()
+
+
+async def _soak(seed: int, heights: int) -> None:
+    metrics.reset()
+    injector = FaultInjector(seed, _SOAK_CFG)
+    with replay_on_failure(injector):
+        cluster = _ChaosCluster(6, injector)
+        try:
+            for h in range(1, heights + 1):
+                await cluster.run_height(h)
+            for core, _ in cluster.nodes:
+                assert len(core.backend.inserted) == heights, (
+                    f"node finalized {len(core.backend.inserted)} of "
+                    f"{heights} heights under chaos seed {seed}"
+                )
+            # every height was decided by consensus; block sync only ever
+            # covered stranded tails, never the whole cluster
+            assert cluster.synced_heights < heights * len(cluster.nodes) // 2
+            # the soak must actually have injected chaos to prove anything
+            injected = sum(
+                metrics.counters_snapshot(("go-ibft", "chaos")).values()
+            )
+            assert injected > 0, "chaos schedule injected no faults"
+        finally:
+            cluster.close()
+            # let chaotic call_later deliveries land before the leak check
+            await asyncio.sleep(0.03)
+
+
+async def test_chaos_soak_smoke():
+    """Tier-1 single-seed smoke: 6 nodes, 2 heights, fixed schedule."""
+    await _soak(seed=1, heights=2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [2, 3, 4])
+async def test_chaos_soak(seed):
+    """Full soak: 6 nodes finalize 5 heights under every seeded schedule."""
+    await _soak(seed=seed, heights=5)
+
+
+def test_chaotic_backend_gates_crypto_predicates():
+    from go_ibft_tpu.chaos import ChaoticBackend
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+
+    key = PrivateKey.from_seed(b"cb-0")
+    src = ECDSABackend.static_validators({key.address: 1})
+    inner = ECDSABackend(key, src)
+    broken = ChaoticBackend(
+        inner, FaultInjector(9, FaultConfig(device_error_rate=1.0)), "backend"
+    )
+    with pytest.raises(InjectedDeviceError):
+        broken.is_valid_validator(_msg())
+    # non-gated backend methods forward untouched
+    assert broken.id() == key.address
+
+    clean = ChaoticBackend(inner, FaultInjector(9, FaultConfig()), "backend")
+    msg = inner.build_prepare_message(b"\x22" * 32, View(height=1, round=0))
+    assert clean.is_valid_validator(msg)
+
+
+def test_chaotic_dispatch_faults_inside_pipeline():
+    from go_ibft_tpu.chaos import chaotic_dispatch
+    from go_ibft_tpu.verify import VerifyPipeline
+
+    inj = FaultInjector(4, FaultConfig(device_error_burst=1))
+    dispatch = chaotic_dispatch(lambda packed: packed, inj, "pipeline")
+    pipe = VerifyPipeline(depth=2)
+    with pytest.raises(InjectedDeviceError):
+        pipe.run([1, 2, 3], pack=lambda x: x, dispatch=dispatch, readback=lambda h: h)
+    # burst exhausted: the same injector now passes work through
+    report = pipe.run(
+        [1, 2, 3], pack=lambda x: x, dispatch=dispatch, readback=lambda h: h
+    )
+    assert report.results == [1, 2, 3]

@@ -111,3 +111,84 @@ async def test_roundtrip_between_two_transports():
     finally:
         await ta.stop()
         await tb.stop()
+
+
+# -- retry with jittered backoff + send deadline (ISSUE 3) -------------------
+
+
+async def test_send_retries_transient_failure_until_success():
+    """A transiently failing peer recovers within the send deadline: the
+    retry loop (jittered exponential backoff) re-sends instead of waiting
+    a whole round change."""
+    from go_ibft_tpu.utils import metrics
+
+    metrics.reset()
+    t = GrpcTransport(
+        "127.0.0.1:0",
+        {},
+        lambda m: None,
+        send_deadline_s=2.0,
+        base_backoff_s=0.001,
+        retry_seed=7,
+    )
+    calls = []
+
+    async def stub(payload, timeout=None):
+        calls.append(timeout)
+        if len(calls) < 3:
+            raise grpc.RpcError()
+        return b""
+
+    await t._send("peer", stub, b"x")
+    assert len(calls) == 3
+    assert metrics.get_counter(("go-ibft", "transport", "retries")) == 2
+    assert metrics.get_counter(("go-ibft", "transport", "send_failures")) == 0
+    # every attempt carried a per-attempt timeout within the deadline
+    assert all(0 < tmo <= 2.0 for tmo in calls)
+
+
+async def test_send_gives_up_at_deadline():
+    """A dead peer exhausts the bounded deadline quickly — the transport
+    must never spin past the round budget it serves."""
+    from go_ibft_tpu.utils import metrics
+
+    metrics.reset()
+    t = GrpcTransport(
+        "127.0.0.1:0",
+        {},
+        lambda m: None,
+        send_deadline_s=0.05,
+        base_backoff_s=0.005,
+        retry_seed=7,
+    )
+
+    async def stub(payload, timeout=None):
+        raise grpc.RpcError()
+
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    await t._send("peer", stub, b"x")  # returns, never raises
+    assert loop.time() - t0 < 1.0
+    assert metrics.get_counter(("go-ibft", "transport", "send_failures")) == 1
+
+
+def test_send_deadline_bounded_below_round_timeout():
+    """The constructor clamps the deadline so retry sequences can never
+    outlive the round-0 timeout (round semantics stay the protocol's)."""
+    from go_ibft_tpu.core.ibft import DEFAULT_BASE_ROUND_TIMEOUT
+
+    t = GrpcTransport("127.0.0.1:0", {}, lambda m: None, send_deadline_s=1e9)
+    assert t.send_deadline_s < DEFAULT_BASE_ROUND_TIMEOUT
+    assert t.send_deadline_s == GrpcTransport.MAX_SEND_DEADLINE_S
+
+
+async def test_retry_jitter_is_seedable_and_deterministic():
+    seq_a = GrpcTransport(
+        "127.0.0.1:0", {}, lambda m: None, retry_seed=3
+    )._jitter
+    seq_b = GrpcTransport(
+        "127.0.0.1:0", {}, lambda m: None, retry_seed=3
+    )._jitter
+    assert [seq_a.uniform(0.5, 1.5) for _ in range(8)] == [
+        seq_b.uniform(0.5, 1.5) for _ in range(8)
+    ]

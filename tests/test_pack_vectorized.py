@@ -330,3 +330,98 @@ def test_pack_cache_clear_and_note_round():
     cache.clear()
     assert len(cache) == 0
     assert cache.lookup(prepares[0]) is None
+
+
+# -- malformed-lane validation (ISSUE 3 satellite) ---------------------------
+# The vectorized packers must never die in an opaque numpy frombuffer /
+# reshape error: wrong-length signatures and addresses are validated up
+# front and raise MalformedLaneError NAMING the lane, at exactly the inputs
+# where the reference loop packers also raise (parity pinned both ways).
+
+
+def test_pack_sender_batch_malformed_signature_names_lane():
+    from go_ibft_tpu.verify.batch import MalformedLaneError
+
+    prepares, _, _ = _signed(4)
+    prepares[2].signature = prepares[2].signature[:40]  # truncated
+    with pytest.raises(MalformedLaneError) as err:
+        pack_sender_batch(prepares)
+    assert err.value.lane == 2
+    assert err.value.field == "signature"
+    # the reference loop packer raises on the same batch (parity: the
+    # vectorized path rejects exactly what the oracle rejects)
+    with pytest.raises(ValueError):
+        _pack_sender_batch_reference(prepares)
+    # MalformedLaneError IS a ValueError: pre-existing callers still catch
+    assert isinstance(err.value, ValueError)
+
+
+def test_pack_sender_batch_malformed_sender_names_lane():
+    from go_ibft_tpu.verify.batch import MalformedLaneError
+
+    prepares, _, _ = _signed(3)
+    prepares[1].sender = b"short"
+    with pytest.raises(MalformedLaneError) as err:
+        pack_sender_batch(prepares)
+    assert (err.value.lane, err.value.field) == (1, "sender")
+    with pytest.raises(ValueError):
+        _pack_sender_batch_reference(prepares)
+
+
+def test_pack_seal_batch_malformed_lanes_and_hash():
+    from go_ibft_tpu.verify.batch import MalformedLaneError
+
+    _, seals, phash = _signed(3)
+    bad = list(seals)
+    bad[1] = CommittedSeal(signer=bad[1].signer, signature=b"\x01" * 30)
+    with pytest.raises(MalformedLaneError) as err:
+        pack_seal_batch(phash, bad)
+    assert (err.value.lane, err.value.field) == (1, "signature")
+    with pytest.raises(ValueError):
+        _pack_seal_batch_reference(phash, bad)
+
+    bad_signer = list(seals)
+    bad_signer[2] = CommittedSeal(signer=b"x" * 7, signature=seals[2].signature)
+    with pytest.raises(MalformedLaneError) as err:
+        pack_seal_batch(phash, bad_signer)
+    assert (err.value.lane, err.value.field) == (2, "signer")
+
+    # a wrong-length proposal hash is batch-wide, not a lane: typed
+    # ValueError instead of the old frombuffer crash
+    with pytest.raises(ValueError, match="proposal hash"):
+        pack_seal_batch(b"\x11" * 31, seals)
+
+
+def test_split_signatures_is_malformed_lane_error():
+    from go_ibft_tpu.verify.batch import MalformedLaneError, _split_signatures
+
+    with pytest.raises(MalformedLaneError) as err:
+        _split_signatures([b"\x00" * SIG_BYTES, b"\x00" * 64])
+    assert err.value.lane == 1
+
+
+def test_valid_batches_still_bit_identical_after_validation():
+    """The added validation must not change a single bit of valid packs."""
+    prepares, seals, phash = _signed(5)
+    _assert_tuples_identical(
+        pack_sender_batch(prepares), _pack_sender_batch_reference(prepares)
+    )
+    _assert_tuples_identical(
+        pack_seal_batch(phash, seals), _pack_seal_batch_reference(phash, seals)
+    )
+
+
+def test_pack_cache_evict_on_quarantine():
+    """A quarantined lane's cached pack must be evicted so a corrected
+    re-send is never served the condemned lane (ISSUE 3 satellite)."""
+    prepares, _, _ = _signed(3)
+    cache = PackCache()
+    pack_sender_batch(prepares, cache=cache)
+    assert len(cache) == 3
+    cache.evict(prepares[1])
+    assert len(cache) == 2
+    assert cache.lookup(prepares[1]) is None
+    assert cache.lookup(prepares[0]) is not None
+    # evicting an uncached message is a no-op, not an error
+    cache.evict(prepares[1])
+    assert len(cache) == 2

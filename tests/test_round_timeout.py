@@ -1,0 +1,91 @@
+"""Round-timeout arithmetic: exponential growth, overflow saturation, and
+the interaction with ``extend_round_timeout`` across round changes.
+
+ISSUE 3 satellite: ``base * 2^round`` overflows Python floats past round
+~1023 (``OverflowError``, which would CRASH the round-timer worker mid
+sequence); the exponent now saturates at ``MAX_TIMEOUT_EXPONENT`` so any
+round number yields a finite, monotone timeout.
+"""
+
+import asyncio
+
+from go_ibft_tpu.core import IBFT
+from go_ibft_tpu.core.ibft import (
+    MAX_TIMEOUT_EXPONENT,
+    _RoundSignals,
+    get_round_timeout,
+)
+
+from harness import MockBackend, NullLogger
+
+
+def test_exponential_doubling_low_rounds():
+    for r in range(12):
+        assert get_round_timeout(10.0, 0.0, r) == 10.0 * (2.0**r)
+
+
+def test_additional_timeout_added_after_exponent():
+    assert get_round_timeout(10.0, 3.0, 0) == 13.0
+    assert get_round_timeout(10.0, 3.0, 4) == 10.0 * 16 + 3.0
+    # the additional term is NOT scaled by the round factor
+    assert get_round_timeout(0.0, 7.0, 20) == 7.0
+
+
+def test_high_rounds_saturate_instead_of_overflow():
+    capped = get_round_timeout(10.0, 0.0, MAX_TIMEOUT_EXPONENT)
+    # rounds past the cap return the same finite value: no OverflowError
+    for r in (MAX_TIMEOUT_EXPONENT + 1, 1024, 10_000, 1 << 40):
+        t = get_round_timeout(10.0, 0.0, r)
+        assert t == capped
+        assert t != float("inf")
+    # additional still applies above the cap
+    assert get_round_timeout(10.0, 5.0, 10_000) == capped + 5.0
+
+
+def test_monotone_nondecreasing_across_cap():
+    prev = 0.0
+    for r in range(0, MAX_TIMEOUT_EXPONENT + 8):
+        t = get_round_timeout(1.0, 0.0, r)
+        assert t >= prev
+        prev = t
+
+
+class _T:
+    def multicast(self, message):
+        pass
+
+
+async def test_timer_worker_uses_formula_across_round_changes():
+    """The live round timer must consume exactly
+    ``get_round_timeout(base, additional, round)`` — including an
+    ``extend_round_timeout`` issued between rounds and a saturated
+    high-round value (which must not raise out of the worker)."""
+    captured = []
+    real_sleep = asyncio.sleep
+
+    async def fake_sleep(delay, *args, **kwargs):
+        captured.append(delay)
+
+    core = IBFT(NullLogger(), MockBackend(b"node-t"), _T())
+    core.set_base_round_timeout(2.0)
+    asyncio.sleep = fake_sleep
+    try:
+        await core._start_round_timer(_RoundSignals(), 0)
+        core.extend_round_timeout(1.5)
+        await core._start_round_timer(_RoundSignals(), 3)
+        await core._start_round_timer(_RoundSignals(), 5000)  # saturated
+    finally:
+        asyncio.sleep = real_sleep
+    assert captured == [
+        2.0,
+        2.0 * 8 + 1.5,
+        2.0 * (2.0**MAX_TIMEOUT_EXPONENT) + 1.5,
+    ]
+
+
+async def test_timer_fires_round_expired_signal():
+    core = IBFT(NullLogger(), MockBackend(b"node-t"), _T())
+    core.set_base_round_timeout(0.01)
+    signals = _RoundSignals()
+    await core._start_round_timer(signals, 0)
+    assert signals.round_expired.done()

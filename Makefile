@@ -1,7 +1,7 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
-	warm cluster-bench
+	warm cluster-bench obs-report
 
 test:
 	python -m pytest tests/ -q
@@ -26,6 +26,11 @@ native:
 
 bench:
 	python bench.py
+
+# Regression gates: fresh bench evidence (bench_evidence.jsonl) vs the
+# best prior BENCH_r*.json on the same backend (go_ibft_tpu/obs/gates.py)
+obs-report:
+	python scripts/obs_report.py
 
 # Pre-warm the expensive kernel compiles into the persistent XLA cache
 # (CI slow tier runs this before pytest so no compile hits a test timeout)

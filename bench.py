@@ -20,15 +20,29 @@ platform line) flags that nothing below is TPU perf evidence, every
 config then records a host-routed (scaled where needed) measurement under
 its BASELINE.md metric key, the headline key stays reserved for a live
 chip, and a late re-probe captures ``evidence_tpu.jsonl`` if the tunnel
-woke up mid-run.  Exit code reports CRASHES only: rc 0 means every
-runnable config completed (fallback included); rc != 0 means a config
-raised.
+woke up mid-run.
+
+Evidence discipline (ISSUE 4): the backend probe runs in a subprocess
+with a hard wall-clock deadline behind a TTL'd on-disk fingerprint cache
+(``go_ibft_tpu.obs.evidence`` — ``--reprobe`` bypasses it), so this
+process can never block on ``jax.devices()``; every metric line is
+mirrored to an append-only, per-record-flushed JSONL evidence file
+(``--evidence``, default ``bench_evidence.jsonl``) stamped with
+``backend: tpu|cpu-fallback`` and ``probe: ok|timeout|cached``, so a
+crash mid-run still leaves every completed config's evidence on disk.
+Exit code: rc 0 is reserved strictly for "every config produced an
+evidence line and none crashed"; rc != 0 means a config raised or left no
+evidence.  ``--trace out.json`` records the flight-recorder spans of the
+whole run and exports a Chrome/Perfetto trace at exit
+(``go_ibft_tpu.obs.trace``; ``scripts/obs_report.py`` gates fresh
+evidence against prior rounds).
 
 A differential correctness smoke (device masks vs the host crypto oracle,
 including corrupted lanes) runs BEFORE any timing: a wrong kernel can
 never silently "benchmark".
 """
 
+import argparse
 import json
 import os
 import statistics
@@ -64,13 +78,23 @@ def _reps() -> int:
     return 3 if _FALLBACK else REPS
 
 
+# Evidence writer (go_ibft_tpu.obs.evidence.EvidenceWriter) once main()
+# has a probe fingerprint; every metric line printed after that point is
+# mirrored — append-only, flushed per record — so a crash mid-config
+# loses nothing already measured.
+_EVIDENCE = None
+_FINGERPRINT = None
+
+
 def _log(obj) -> None:
     print(json.dumps(obj), flush=True)
+    if _EVIDENCE is not None and "metric" in obj:
+        _EVIDENCE.record(obj["metric"], obj)
 
 
-def ensure_live_backend() -> str:
-    """Probe the default JAX backend (shared subprocess probe); pin CPU if
-    it's dead.
+def ensure_live_backend(reprobe: bool = False) -> str:
+    """Probe the default JAX backend (cached subprocess fingerprint); pin
+    CPU if it's dead.
 
     Rounds 1-2 produced NO benchmark number because the tunneled TPU
     backend failed/hung at init time and the process exited 1 before any
@@ -81,17 +105,30 @@ def ensure_live_backend() -> str:
     the budget for the fallback report.  A live-but-cold tunnel handshake
     can take minutes, so the clamp keeps the probe as LONG as the budget
     affords rather than defaulting short.
-    """
-    from go_ibft_tpu.utils.probe import probe_default_backend, probe_timeout_s
 
-    timeout = max(30.0, min(probe_timeout_s(), _remaining_s() * 0.5))
-    platform, detail = probe_default_backend(timeout)
-    if platform is not None:
-        return platform
+    The probe itself is ``go_ibft_tpu.obs.evidence.probe_fingerprint``:
+    a subprocess under a hard deadline (this process can never hang on
+    ``jax.devices()``) behind a TTL'd on-disk cache, so repeat probe
+    points within the TTL cost a file read.  ``reprobe`` (the ``--reprobe``
+    flag) bypasses the cache.
+    """
+    global _FINGERPRINT
+    from go_ibft_tpu.obs.evidence import probe_fingerprint
+    from go_ibft_tpu.utils.probe import probe_timeout_s
+
+    # Floor: a live-but-cold tunnel handshake needs time, so never clamp
+    # below 30s — unless the operator explicitly set a SMALLER
+    # GO_IBFT_PROBE_TIMEOUT (the hang-proof contract tests do).
+    floor = min(30.0, probe_timeout_s())
+    timeout = max(floor, min(probe_timeout_s(), _remaining_s() * 0.5))
+    fp = probe_fingerprint(timeout, reprobe=reprobe)
+    _FINGERPRINT = fp
+    if fp.platform is not None:
+        return fp.platform
     # "probe_error", not "error": CI fails the bench job on any '"error"'
     # line, and the run may still produce a valid (fallback-labeled)
     # artifact after a probe miss.
-    _log({"metric": "backend_probe", "probe_error": detail})
+    _log({"metric": "backend_probe", "probe_error": fp.detail, "probe": fp.probe})
     jax.config.update("jax_platforms", "cpu")
     return "cpu (fallback: default backend unavailable)"
 
@@ -1072,17 +1109,120 @@ config5_host_scaled.metric = config5_byzantine_mix.metric
 config2_host_fallback.metric = headline_metric(True)
 
 
-def main() -> None:
-    global _FALLBACK
+# The per-branch run schedules: (config_fn, wall-clock reserve for the
+# configs behind it).  The rc=0 evidence contract is DERIVED from these
+# same tuples (``_expected_configs``) so the executed set and the
+# expected-evidence set can never drift apart.  Config #1 runs last on
+# the fallback branch (its line is the round's parity acceptance metric
+# and must stay the final parsed line); the headline runs last on a live
+# chip (guarded separately in _run).
+_FALLBACK_SCHEDULE = (
+    (config3_host_scaled, 170.0),
+    (config4_host_scaled, 120.0),
+    (config5_host_scaled, 90.0),
+    (config6_chaos, 65.0),
+    (config2_host_fallback, 45.0),
+    (config1_happy_path, 0.0),
+)
+_DEVICE_SCHEDULE = (
+    (config1_happy_path, 480.0),
+    (config3_pipelined, 420.0),
+    (config4_bls, 360.0),
+    (config5_byzantine_mix, 320.0),
+    (config6_chaos, 300.0),
+)
 
+
+def _expected_configs(fallback: bool) -> tuple:
+    schedule = _FALLBACK_SCHEDULE if fallback else _DEVICE_SCHEDULE
+    expected = [fn.metric for fn, _ in schedule]
+    if not fallback:
+        expected.append(headline_metric(False))
+    return tuple(dict.fromkeys(expected))
+
+
+def _finish(failures: list) -> None:
+    """Exit-code contract: rc=0 strictly for 'every config produced an
+    evidence line and none crashed' (ISSUE 4); a crash or an evidence gap
+    is rc=1, platform degradation alone is not."""
+    missing = (
+        _EVIDENCE.missing(_expected_configs(_FALLBACK))
+        if _EVIDENCE is not None
+        else list(_expected_configs(_FALLBACK))
+    )
+    if missing:
+        _log({"metric": "bench_evidence_gap", "value": missing})
+    if failures:
+        _log({"metric": "bench_failures", "value": failures})
+    sys.exit(1 if failures or missing else 0)
+
+
+def main(argv=None) -> None:
+    from go_ibft_tpu.obs import trace as obs_trace
+
+    parser = argparse.ArgumentParser(description="BASELINE.md benchmark matrix")
+    parser.add_argument(
+        "--trace",
+        metavar="OUT_JSON",
+        default=None,
+        help="record flight-recorder spans and export a Chrome/Perfetto "
+        "trace to this path at exit",
+    )
+    parser.add_argument(
+        "--reprobe",
+        action="store_true",
+        help="bypass the TTL'd backend-fingerprint cache "
+        "(~/.cache/go_ibft_tpu/probe.json) and probe fresh",
+    )
+    parser.add_argument(
+        "--evidence",
+        default=os.environ.get("GO_IBFT_EVIDENCE_PATH", "bench_evidence.jsonl"),
+        help="per-config evidence JSONL (append-only, flushed per record)",
+    )
+    args = parser.parse_args(argv)
+    if args.trace:
+        obs_trace.enable()
+    try:
+        _run(args)
+    finally:
+        if args.trace:
+            from go_ibft_tpu.obs.export import write_chrome_trace
+
+            n_events = write_chrome_trace(args.trace)
+            rec = obs_trace.recorder()
+            # Ring overflow orphans spans near the wrap boundary (their
+            # children were overwritten first) — surface it so nobody
+            # reads a truncated window as a complete flight record.
+            _log(
+                {
+                    "metric": "trace_export",
+                    "value": n_events,
+                    "path": args.trace,
+                    "dropped_records": rec.dropped if rec is not None else 0,
+                }
+            )
+        if _EVIDENCE is not None:
+            _EVIDENCE.close()
+
+
+def _run(args) -> None:
+    global _FALLBACK, _EVIDENCE
+
+    from go_ibft_tpu.obs.evidence import EvidenceWriter
     from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
 
-    platform = ensure_live_backend()
+    platform = ensure_live_backend(reprobe=args.reprobe)
     # Degraded unless the live platform IS a TPU ("axon" = the tunneled TPU
     # PJRT plugin).  Keying off probe failure alone would let a container
     # whose default backend is natively CPU publish the headline with rc=0
     # — the same evidence hole as a dead tunnel, through a different door.
     _FALLBACK = platform not in ("tpu", "axon")
+    _EVIDENCE = EvidenceWriter(
+        args.evidence,
+        backend="cpu-fallback" if _FALLBACK else "tpu",
+        probe=_FINGERPRINT.probe if _FINGERPRINT is not None else "error",
+        truncate=True,
+    )
     enable_persistent_cache()
     _log({"metric": "bench_platform", "value": platform})
 
@@ -1112,20 +1252,16 @@ def main() -> None:
             }
         )
         failures = []
-        for config_fn, reserve in (
-            (config3_host_scaled, 170.0),
-            (config4_host_scaled, 120.0),
-            (config5_host_scaled, 90.0),
-            (config6_chaos, 65.0),
-            (config2_host_fallback, 45.0),
-        ):
+        # Everything but config #1, which runs after the late re-probe so
+        # its parity line stays the final parsed line.
+        for config_fn, reserve in _FALLBACK_SCHEDULE[:-1]:
             _guarded(config_fn, failures, reserve_s=reserve)
         # Opportunistic TPU evidence: a tunnel that woke up after the
         # startup probe still yields evidence_tpu.jsonl (fresh subprocess —
         # THIS process is pinned to CPU).  Runs before config #1 so the
         # happy-path line, the round's parity acceptance metric, stays the
         # final parsed line.
-        from go_ibft_tpu.bench.evidence import reprobe_and_capture
+        from go_ibft_tpu.obs.evidence import reprobe_and_capture
 
         tpu_platform, detail = reprobe_and_capture(
             _remaining_s() - 45.0, os.path.abspath(__file__)
@@ -1140,10 +1276,9 @@ def main() -> None:
             )
         else:
             _log({"metric": "tpu_reprobe", "value": None, "probe_error": detail})
-        _guarded(config1_happy_path, failures, reserve_s=0.0)
-        if failures:
-            _log({"metric": "bench_failures", "value": failures})
-        sys.exit(1 if failures else 0)
+        last_fn, last_reserve = _FALLBACK_SCHEDULE[-1]
+        _guarded(last_fn, failures, reserve_s=last_reserve)
+        _finish(failures)
 
     try:
         differential_smoke()
@@ -1165,13 +1300,7 @@ def main() -> None:
     # Reserves: each config leaves room for everything behind it; the
     # headline's own reserve (300 s: one certify compile + 2x30 reps) is
     # what the secondaries must never eat into.
-    for config_fn, reserve in (
-        (config1_happy_path, 480.0),
-        (config3_pipelined, 420.0),
-        (config4_bls, 360.0),
-        (config5_byzantine_mix, 320.0),
-        (config6_chaos, 300.0),
-    ):
+    for config_fn, reserve in _DEVICE_SCHEDULE:
         _guarded(config_fn, failures, reserve_s=reserve)
     # Headline LAST: drivers read the final JSON line.  Guarded so a
     # failure (or an exhausted budget) still ends the artifact with an
@@ -1196,9 +1325,7 @@ def main() -> None:
             }
         )
         sys.exit(1)
-    if failures:  # a config CRASHED: diagnostics line + nonzero rc
-        _log({"metric": "bench_failures", "value": failures})
-        sys.exit(1)
+    _finish(failures)
 
 
 if __name__ == "__main__":

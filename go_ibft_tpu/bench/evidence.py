@@ -1,66 +1,11 @@
-"""Opportunistic TPU evidence capture for CPU-fallback bench runs.
+"""Superseded by :mod:`go_ibft_tpu.obs.evidence` (ISSUE 4).
 
-Rounds 1-5 lost every TPU window that opened mid-round: ``bench.py``
-probes ONCE at startup (retries burn budget against outages that are
-either instant or hours long), so a tunnel that woke up after the probe
-contributed nothing.  A fallback run now re-probes near its end and, when
-the default backend came alive, relaunches the bench in a FRESH
-subprocess — this process pinned ``jax_platforms=cpu`` at startup and can
-never use the chip itself — appending the child's JSON lines to
-``evidence_tpu.jsonl`` (the same artifact ``scripts/tpu_evidence.sh``
-builds).
-
-The child emits the same line schema as the parent, so first-class
-packing/pipelining attribution (``pack_ms``, ``pack_lanes_per_s``,
-``pipeline_speedup``, ``overlap_efficiency`` on the config #3 line — CPU
-and TPU variants alike) is captured here without any extra plumbing.
+The opportunistic TPU capture helper moved into the observability
+subsystem alongside the fingerprint cache and the evidence writer; this
+module remains as a re-export so older scripts and embedders keep
+importing from the historical location.
 """
 
-from __future__ import annotations
+from ..obs.evidence import EVIDENCE_PATH, reprobe_and_capture
 
-import os
-import subprocess
-import sys
-from typing import Optional, Tuple
-
-from ..utils.probe import probe_default_backend
-
-EVIDENCE_PATH = "evidence_tpu.jsonl"
-
-
-def reprobe_and_capture(
-    remaining_s: float,
-    bench_path: str,
-    evidence_path: str = EVIDENCE_PATH,
-) -> Tuple[Optional[str], str]:
-    """Late re-probe; on a live TPU, run ``bench.py`` in a subprocess.
-
-    Returns ``(platform_or_None, detail)``: platform is the live TPU
-    platform name when evidence was captured (detail names the artifact),
-    else ``None`` with a one-line reason.  Budget discipline mirrors the
-    parent: the probe is clamped well under ``remaining_s`` and the child
-    gets what is left minus a reserve, so the parent always finishes its
-    own report.
-    """
-    if remaining_s < 240.0:
-        return None, f"skipped: {remaining_s:.0f}s of budget left"
-    platform, detail = probe_default_backend(min(45.0, remaining_s * 0.15))
-    if platform not in ("tpu", "axon"):
-        return None, detail if platform is None else f"backend is {platform!r}"
-    child_budget = max(120.0, remaining_s - 90.0)
-    env = dict(os.environ, GO_IBFT_BENCH_BUDGET_S=str(int(child_budget)))
-    env.pop("JAX_PLATFORMS", None)  # the child must see the live default
-    try:
-        with open(evidence_path, "a") as fh:
-            subprocess.run(
-                [sys.executable, bench_path],
-                stdout=fh,
-                stderr=subprocess.DEVNULL,
-                timeout=child_budget + 30.0,
-                env=env,
-                cwd=os.path.dirname(os.path.abspath(bench_path)) or ".",
-                check=False,
-            )
-    except (OSError, subprocess.TimeoutExpired) as err:
-        return None, f"evidence run failed: {type(err).__name__}"
-    return platform, evidence_path
+__all__ = ["EVIDENCE_PATH", "reprobe_and_capture"]

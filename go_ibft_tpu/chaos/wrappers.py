@@ -20,14 +20,18 @@ import time
 from typing import Callable, List, Optional
 
 from ..messages.wire import IbftMessage
+from ..obs import trace
 from ..utils import metrics
 from .injector import FaultInjector
 
 _CHAOS = "chaos"
 
 
-def _count(kind: str, n: int = 1) -> None:
+def _count(kind: str, n: int = 1, site: Optional[str] = None) -> None:
     metrics.inc_counter(("go-ibft", _CHAOS, kind), n)
+    # Injection sites land on the flight-recorder timeline: a chaotic soak
+    # trace shows WHERE each fault hit relative to the round phases.
+    trace.instant("chaos." + kind, site=site)
 
 
 def corrupt_message(message: IbftMessage, bit: int) -> Optional[IbftMessage]:
@@ -90,16 +94,16 @@ class ChaoticDeliver:
     def __call__(self, message: IbftMessage) -> None:
         fault = self._injector.transport_fault(self.site)
         if fault.drop:
-            _count("dropped")
+            _count("dropped", site=self.site)
             return
         if fault.corrupt_bit >= 0:
-            _count("corrupted")
+            _count("corrupted", site=self.site)
             message = corrupt_message(message, fault.corrupt_bit)
             if message is None:  # undecodable frame: the link ate it
                 return
         copies = [message, message] if fault.duplicate else [message]
         if fault.duplicate:
-            _count("duplicated")
+            _count("duplicated", site=self.site)
         loop = self._loop()
         if loop is None:
             self._flush_held()
@@ -107,12 +111,12 @@ class ChaoticDeliver:
                 self._deliver(m)
             return
         if fault.reorder:
-            _count("reordered")
+            _count("reordered", site=self.site)
             self._held.extend(copies)
             loop.call_later(self._flush_after_s, self._flush_held)
             return
         if fault.delay_s > 0:
-            _count("delayed")
+            _count("delayed", site=self.site)
             for m in copies:
                 loop.call_later(fault.delay_s, self._deliver, m)
         else:
@@ -162,10 +166,10 @@ class ChaoticVerifier:
     def _gate(self) -> None:
         fault = self._injector.verify_fault(self.site)
         if fault.slow_s > 0:
-            _count("slow_verifies")
+            _count("slow_verifies", site=self.site)
             time.sleep(fault.slow_s)
         if fault.device_error:
-            _count("device_errors")
+            _count("device_errors", site=self.site)
             raise self._injector.device_error(self.site)
 
     def verify_senders(self, msgs):
@@ -208,10 +212,10 @@ class ChaoticBackend:
     def _gate(self) -> None:
         fault = self._injector.verify_fault(self.site)
         if fault.slow_s > 0:
-            _count("slow_verifies")
+            _count("slow_verifies", site=self.site)
             time.sleep(fault.slow_s)
         if fault.device_error:
-            _count("device_errors")
+            _count("device_errors", site=self.site)
             raise self._injector.device_error(self.site)
 
     def is_valid_validator(self, msg):
@@ -238,10 +242,10 @@ def chaotic_dispatch(
     def wrapped(packed):
         fault = injector.verify_fault(site)
         if fault.slow_s > 0:
-            _count("slow_verifies")
+            _count("slow_verifies", site=site)
             time.sleep(fault.slow_s)
         if fault.device_error:
-            _count("device_errors")
+            _count("device_errors", site=site)
             raise injector.device_error(site)
         return dispatch(packed)
 

@@ -36,6 +36,7 @@ from ..messages.wire import (
     RoundChangeCertificate,
     View,
 )
+from ..obs import trace
 from ..utils.metrics import set_gauge
 from .backend import Backend, BatchVerifier
 from .state import SequenceState, StateName
@@ -162,6 +163,13 @@ class IBFT:
         # carried hash now costs one backend call per round.
         self._hash_memo: dict[bytes, bool] = {}
         self._hash_memo_cap = 1024
+        # Flight-recorder track: one timeline row per node, so a 6-node
+        # height renders as six labeled rows (obs/export.py).  Named after
+        # the validator identity when the backend provides one.
+        try:
+            self._obs_track = "node-" + bytes(backend.id()).hex()[:16]
+        except Exception:  # noqa: BLE001 - mocks without a stable id
+            self._obs_track = f"node-{id(self) & 0xFFFF:04x}"
 
     # -- configuration (reference core/ibft.go:1151-1159) -------------------
 
@@ -211,6 +219,7 @@ class IBFT:
         self.messages.prune_by_height(height)
 
         self.log.info("sequence started", height)
+        trace.instant("sequence.start", track=self._obs_track, height=height)
         try:
             while True:
                 view = self.state.view
@@ -223,6 +232,12 @@ class IBFT:
                     )
 
                 self.log.info("round started", view.round)
+                trace.instant(
+                    "round.start",
+                    track=self._obs_track,
+                    height=height,
+                    round=view.round,
+                )
 
                 current_round = view.round
                 signals = _RoundSignals()
@@ -315,6 +330,7 @@ class IBFT:
         finally:
             self._signals = None
             set_gauge(("go-ibft", "sequence", "duration"), time.monotonic() - start_time)
+            trace.instant("sequence.done", track=self._obs_track, height=height)
             self.log.info("sequence done", height)
 
     # -- round workers ------------------------------------------------------
@@ -327,6 +343,12 @@ class IBFT:
         )
         try:
             await asyncio.sleep(timeout)
+            trace.instant(
+                "round.timeout",
+                track=self._obs_track,
+                round=round_,
+                timeout_s=timeout,
+            )
             signals.fire(signals.round_expired)
         finally:
             set_gauge(("go-ibft", "round", "duration"), time.monotonic() - start_time)
@@ -447,7 +469,10 @@ class IBFT:
                 wake = await sub.wait()
                 if wake is None:
                     return True
-                proposal_message = self._handle_preprepare(view)
+                with trace.span(
+                    "proposal.drain", track=self._obs_track, round=view.round
+                ):
+                    proposal_message = self._handle_preprepare(view)
                 if proposal_message is None:
                     continue
 
@@ -477,7 +502,11 @@ class IBFT:
                 # are covered by the store re-read below — coalesce them
                 # instead of re-draining the phase once per signal.
                 sub.drain_pending()
-                if not self._handle_prepare(view):
+                with trace.span(
+                    "prepare.drain", track=self._obs_track, round=view.round
+                ):
+                    quorum = self._handle_prepare(view)
+                if not quorum:
                     continue
                 return False
         finally:
@@ -501,7 +530,11 @@ class IBFT:
                 # repeat it (each repeat is crypto-free thanks to the seal
                 # verdict cache, but still walks the store).
                 sub.drain_pending()
-                if not self._handle_commit(view):
+                with trace.span(
+                    "commit.drain", track=self._obs_track, round=view.round
+                ):
+                    quorum = self._handle_commit(view)
+                if not quorum:
                     continue
                 return False
         finally:
@@ -1010,12 +1043,17 @@ class IBFT:
         """
         if not batch:
             return
-        gated = [m for m in batch if self._gate_height_round(m)]
-        if self.batch_verifier is not None:
-            mask = self.batch_verifier.verify_senders(gated)
-            accepted = [m for m, ok in zip(gated, mask) if bool(ok)]
-        else:
-            accepted = [m for m in gated if self.backend.is_valid_validator(m)]
+        with trace.span(
+            "ingress.batch", track=self._obs_track, lanes=len(batch)
+        ):
+            gated = [m for m in batch if self._gate_height_round(m)]
+            if self.batch_verifier is not None:
+                mask = self.batch_verifier.verify_senders(gated)
+                accepted = [m for m, ok in zip(gated, mask) if bool(ok)]
+            else:
+                accepted = [
+                    m for m in gated if self.backend.is_valid_validator(m)
+                ]
 
         # Store everything first, then signal once per (view, type) key —
         # signaling mid-batch could find quorum incomplete and never re-check.
@@ -1097,6 +1135,7 @@ class IBFT:
 
     def _move_to_new_round(self, round_: int) -> None:
         """(reference core/ibft.go:994-1003)"""
+        trace.instant("round.change", track=self._obs_track, round=round_)
         self._hash_memo.clear()
         # Round advance drives the pack cache's oldest-round-first eviction
         # (entries packed for dead rounds yield before the live round's).
@@ -1109,6 +1148,9 @@ class IBFT:
 
     def _accept_proposal(self, proposal_message: IbftMessage) -> None:
         """Accept a proposal and move to PREPARE (reference core/ibft.go:1094-1098)."""
+        trace.instant(
+            "proposal.accept", track=self._obs_track, round=self.state.round
+        )
         self._hash_memo.clear()
         self.state.set_proposal_message(proposal_message)
         self.state.change_state(StateName.PREPARE)

@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Sequence
 import grpc
 
 from ..core.ibft import DEFAULT_BASE_ROUND_TIMEOUT
+from ..obs import trace
 from ..utils import metrics
 
 from ..messages.wire import IbftMessage
@@ -156,8 +157,11 @@ class GrpcTransport:
 
     def multicast(self, message: IbftMessage) -> None:
         """Encode once, self-deliver locally, fan out to all peers."""
-        payload = message.encode()
-        self._deliver(message)
+        with trace.span(
+            "net.multicast", peers=len(self._stubs), type=int(message.type)
+        ):
+            payload = message.encode()
+            self._deliver(message)
         for name, stub in self._stubs.items():
             task = asyncio.get_running_loop().create_task(
                 self._send(name, stub, payload)
@@ -183,10 +187,11 @@ class GrpcTransport:
             if remaining <= 0:
                 break
             try:
-                await stub(
-                    payload,
-                    timeout=min(self.per_attempt_timeout_s, remaining),
-                )
+                with trace.span("net.send", peer=name, attempt=attempt):
+                    await stub(
+                        payload,
+                        timeout=min(self.per_attempt_timeout_s, remaining),
+                    )
                 return
             except asyncio.CancelledError:
                 return  # transport stopping: drop silently, never retry
@@ -204,8 +209,10 @@ class GrpcTransport:
             if loop.time() + backoff >= deadline:
                 break
             metrics.inc_counter(RETRY_KEY)
+            trace.instant("net.retry", peer=name, attempt=attempt)
             await asyncio.sleep(backoff)
         metrics.inc_counter(SEND_FAILURE_KEY)
+        trace.instant("net.send_failed", peer=name, attempts=attempt)
         if self._log:
             self._log.debug("grpc multicast gave up", name, attempt)
 

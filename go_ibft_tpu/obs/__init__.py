@@ -1,0 +1,43 @@
+"""Observability subsystem: flight recorder, hang-proof evidence, gates.
+
+Three pillars (ISSUE 4):
+
+* :mod:`~go_ibft_tpu.obs.trace` / :mod:`~go_ibft_tpu.obs.recorder` — a
+  zero-dependency, thread-safe span API recording into a fixed-size ring
+  buffer, instrumented at every hot seam (engine round phases, verify
+  pack/dispatch/device-wait, transport sends/retries, chaos injection
+  sites).  Disabled mode costs a single predicate check per call site.
+* :mod:`~go_ibft_tpu.obs.export` — Chrome ``trace_event`` / Perfetto JSON
+  export, so a multi-node height renders as a readable multi-track
+  timeline (``bench.py --trace out.json``, ``scripts/chaos_replay.py
+  --trace``).
+* :mod:`~go_ibft_tpu.obs.evidence` — hang-proof evidence capture: device
+  probing in a subprocess with a hard wall-clock deadline and a cached
+  backend fingerprint (TTL + ``--reprobe``), plus an append-only,
+  per-record-flushed JSONL evidence writer so every bench config leaves a
+  record even when the run crashes mid-way.  Supersedes
+  ``go_ibft_tpu.bench.evidence``.
+* :mod:`~go_ibft_tpu.obs.gates` — regression gates comparing a fresh
+  evidence file against the best prior ``BENCH_r*.json`` per config on the
+  same backend (``scripts/obs_report.py`` / ``make obs-report``), so
+  CPU-fallback rounds still catch regressions without a chip.
+"""
+
+from . import trace
+from .evidence import EvidenceWriter, Fingerprint, probe_fingerprint
+from .export import to_chrome_trace, write_chrome_trace
+from .gates import GateResult, gate_evidence, render_table
+from .recorder import RingRecorder
+
+__all__ = [
+    "trace",
+    "EvidenceWriter",
+    "Fingerprint",
+    "probe_fingerprint",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "GateResult",
+    "gate_evidence",
+    "render_table",
+    "RingRecorder",
+]

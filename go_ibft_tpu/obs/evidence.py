@@ -1,0 +1,285 @@
+"""Hang-proof evidence capture: cached probe fingerprint + JSONL writer.
+
+Supersedes ``go_ibft_tpu.bench.evidence`` (which now re-exports from
+here).  Two jobs:
+
+**1. The backend fingerprint.**  Five rounds of history say the tunneled
+TPU backend can hang ``jax.devices()`` indefinitely (BENCH_r04: three
+120 s probe timeouts; the r05 driver re-probed for 580 s and never got an
+answer).  The bench process must therefore *never* call ``jax.devices()``
+on the default backend itself before a subprocess proved it alive:
+:func:`probe_fingerprint` runs the shared subprocess probe
+(:mod:`go_ibft_tpu.utils.probe` — hard wall-clock deadline, the parent
+decides) and memoizes the verdict to ``~/.cache/go_ibft_tpu/probe.json``
+with a TTL, so repeated probe points within a TTL window cost one file
+read instead of one timeout each.  ``--reprobe`` (or ``reprobe=True``)
+bypasses the cache.  The fingerprint records *how* the verdict was
+obtained — ``probe: ok|timeout|error|cached`` — and every evidence line
+carries it.
+
+**2. The evidence writer.**  Append-only JSONL, one line per record,
+flushed (and fsync'd) per record, so a run killed mid-config still leaves
+every completed config's evidence on disk.  Each line carries the
+required schema fields (``config``, ``metric``, ``backend``, ``probe``,
+``ts``) on top of whatever the caller measured; ``bench.py`` reserves
+rc=0 strictly for "every config produced an evidence line".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import asdict, dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils.probe import probe_default_backend
+
+__all__ = [
+    "Fingerprint",
+    "probe_fingerprint",
+    "EvidenceWriter",
+    "REQUIRED_EVIDENCE_FIELDS",
+    "EVIDENCE_PATH",
+    "reprobe_and_capture",
+]
+
+# Platforms that count as the target accelerator ("axon" = the tunneled
+# TPU PJRT plugin).
+TPU_PLATFORMS = ("tpu", "axon")
+
+DEFAULT_TTL_S = 900.0
+
+REQUIRED_EVIDENCE_FIELDS = ("config", "metric", "backend", "probe", "ts")
+
+
+def _cache_path() -> str:
+    override = os.environ.get("GO_IBFT_PROBE_CACHE")
+    if override:
+        return override
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "go_ibft_tpu", "probe.json"
+    )
+
+
+@dataclass
+class Fingerprint:
+    """One probe verdict: what the default JAX backend looked like, when,
+    and how we found out."""
+
+    platform: Optional[str]  # live default platform, or None when dead
+    probe: str  # "ok" | "timeout" | "error" | "cached"
+    detail: str  # one-line reason for the log
+    probed_at: float  # epoch seconds of the underlying probe
+    # Environment pin: a fingerprint probed under an explicit platform pin
+    # (JAX_PLATFORMS) must not serve a differently-pinned process.
+    env_platforms: str = ""
+
+    def is_tpu(self) -> bool:
+        return self.platform in TPU_PLATFORMS
+
+    def backend_label(self) -> str:
+        """The evidence-schema backend field: ``tpu`` or ``cpu-fallback``."""
+        return "tpu" if self.is_tpu() else "cpu-fallback"
+
+
+def _load_cached(path: str, ttl_s: float) -> Optional[Fingerprint]:
+    try:
+        with open(path) as fh:
+            raw = json.load(fh)
+        fp = Fingerprint(**raw)
+    except (OSError, ValueError, TypeError):
+        return None
+    if time.time() - fp.probed_at > ttl_s:
+        return None
+    if fp.env_platforms != os.environ.get("JAX_PLATFORMS", ""):
+        return None
+    return fp
+
+
+def _store_cached(path: str, fp: Fingerprint) -> None:
+    # Best-effort atomic write: a read-only $HOME must never cost the
+    # caller its probe verdict, and a concurrent reader must never see a
+    # torn file.
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        with os.fdopen(fd, "w") as fh:
+            json.dump(asdict(fp), fh)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def probe_fingerprint(
+    timeout_s: Optional[float] = None,
+    *,
+    ttl_s: Optional[float] = None,
+    reprobe: bool = False,
+    cache_path: Optional[str] = None,
+) -> Fingerprint:
+    """Probe the default backend, via the TTL'd on-disk cache.
+
+    A fresh cached verdict (younger than ``ttl_s``, same ``JAX_PLATFORMS``
+    pin) is returned with ``probe="cached"`` without spawning anything;
+    otherwise the subprocess probe runs under its hard deadline and the
+    verdict — alive, dead, or hung-past-deadline — is cached for the next
+    probe point.  This function can never block past ``timeout_s``: the
+    probe is a subprocess the parent kills, never an in-process
+    ``jax.devices()`` call.
+    """
+    path = cache_path if cache_path is not None else _cache_path()
+    if ttl_s is None:
+        ttl_s = float(os.environ.get("GO_IBFT_PROBE_TTL", DEFAULT_TTL_S))
+    if not reprobe:
+        cached = _load_cached(path, ttl_s)
+        if cached is not None:
+            cached.probe = "cached"
+            return cached
+    platform, detail = probe_default_backend(timeout_s)
+    if platform is not None:
+        status = "ok"
+    elif detail.startswith("probe timeout"):
+        status = "timeout"
+    else:
+        status = "error"
+    fp = Fingerprint(
+        platform=platform,
+        probe=status,
+        detail=detail,
+        probed_at=time.time(),
+        env_platforms=os.environ.get("JAX_PLATFORMS", ""),
+    )
+    _store_cached(path, fp)
+    return fp
+
+
+class EvidenceWriter:
+    """Append-only, per-record-flushed JSONL evidence file.
+
+    The file handle opens in append mode and every :meth:`record` call
+    writes exactly one line, flushes, and fsyncs — a crash (or a driver
+    SIGKILL) after config N loses nothing before N+1.  ``backend`` and
+    ``probe`` (the schema's provenance fields) are stamped on every line
+    from the fingerprint the run started with; callers may override per
+    record (the late TPU re-probe does).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        backend: str = "cpu-fallback",
+        probe: str = "error",
+        truncate: bool = False,
+    ) -> None:
+        self.path = path
+        self.backend = backend
+        self.probe = probe
+        self._fh = open(path, "w" if truncate else "a")
+        self._configs: List[str] = []
+
+    def set_provenance(self, backend: str, probe: str) -> None:
+        self.backend = backend
+        self.probe = probe
+
+    def record(self, config: str, line: Optional[dict] = None, **fields) -> dict:
+        """Append one evidence line for ``config``; returns the full record."""
+        rec = dict(line or {})
+        rec.update(fields)
+        rec.setdefault("metric", config)
+        rec["config"] = config
+        rec.setdefault("backend", self.backend)
+        rec.setdefault("probe", self.probe)
+        rec["ts"] = time.time()
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        try:
+            os.fsync(self._fh.fileno())
+        except OSError:
+            pass
+        self._configs.append(config)
+        return rec
+
+    def covered(self) -> List[str]:
+        """Configs recorded so far, in order."""
+        return list(self._configs)
+
+    def missing(self, expected: Sequence[str]) -> List[str]:
+        seen = set(self._configs)
+        return [c for c in expected if c not in seen]
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "EvidenceWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Opportunistic TPU capture (absorbed from go_ibft_tpu/bench/evidence.py)
+# ---------------------------------------------------------------------------
+
+EVIDENCE_PATH = "evidence_tpu.jsonl"
+
+
+def reprobe_and_capture(
+    remaining_s: float,
+    bench_path: str,
+    evidence_path: str = EVIDENCE_PATH,
+) -> Tuple[Optional[str], str]:
+    """Late re-probe; on a live TPU, run ``bench.py`` in a subprocess.
+
+    A CPU-fallback bench run re-probes near its end (bypassing the
+    fingerprint cache — the whole point is catching a tunnel that woke up
+    AFTER the startup probe) and, when the default backend came alive,
+    relaunches the bench in a FRESH subprocess — the fallback process
+    pinned ``jax_platforms=cpu`` at startup and can never use the chip
+    itself — appending the child's JSON lines to ``evidence_tpu.jsonl``.
+
+    Returns ``(platform_or_None, detail)``: platform is the live TPU
+    platform name when evidence was captured (detail names the artifact),
+    else ``None`` with a one-line reason.  Budget discipline mirrors the
+    parent: the probe is clamped well under ``remaining_s`` and the child
+    gets what is left minus a reserve, so the parent always finishes its
+    own report.
+    """
+    if remaining_s < 240.0:
+        return None, f"skipped: {remaining_s:.0f}s of budget left"
+    fp = probe_fingerprint(min(45.0, remaining_s * 0.15), reprobe=True)
+    if not fp.is_tpu():
+        return None, (
+            fp.detail if fp.platform is None else f"backend is {fp.platform!r}"
+        )
+    child_budget = max(120.0, remaining_s - 90.0)
+    env = dict(os.environ, GO_IBFT_BENCH_BUDGET_S=str(int(child_budget)))
+    env.pop("JAX_PLATFORMS", None)  # the child must see the live default
+    # The child gets its OWN per-config evidence file: it would otherwise
+    # inherit (or re-derive) the parent's path and TRUNCATE it mid-run —
+    # the parent still holds an open append handle and has configs left
+    # to record.  The tpu-side evidence sits next to the stdout capture.
+    env["GO_IBFT_EVIDENCE_PATH"] = evidence_path + ".configs.jsonl"
+    try:
+        with open(evidence_path, "a") as fh:
+            subprocess.run(
+                [sys.executable, bench_path],
+                stdout=fh,
+                stderr=subprocess.DEVNULL,
+                timeout=child_budget + 30.0,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(bench_path)) or ".",
+                check=False,
+            )
+    except (OSError, subprocess.TimeoutExpired) as err:
+        return None, f"evidence run failed: {type(err).__name__}"
+    return fp.platform, evidence_path

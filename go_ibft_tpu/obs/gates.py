@@ -1,0 +1,299 @@
+"""Regression gates: fresh evidence vs the best prior round, per backend.
+
+The VERDICT's round-6 mandate as a subsystem: every round produces an
+evidence file (``bench.py``'s per-config JSONL), and this module compares
+it against the best prior recorded value for the same config **on the
+same backend** — so CPU-fallback rounds still catch packing/pipelining/
+engine regressions without a chip, and a TPU round is never graded
+against a CPU number (or vice versa).
+
+Prior evidence sources, in the repo root:
+
+* ``BENCH_r*.json`` — the driver's per-round artifacts: a JSON object
+  whose ``tail`` field holds the run's JSONL lines (plus ``rc``);
+* plain ``*.jsonl`` evidence files (``bench_evidence.jsonl``,
+  ``evidence_tpu.jsonl``) — one JSON object per line.
+
+Both parse into the same line dicts the bench prints.  Direction
+(lower-is-better vs higher-is-better) derives from the metric's unit:
+latencies and overhead ratios regress upward, throughputs regress
+downward.  Thresholds: > 25% worse than the best prior on the same
+backend fails, > 10% warns, anything else passes; configs with no prior
+(or no fresh measurement where none was expected) report informationally.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "GateResult",
+    "parse_artifact",
+    "artifact_backend",
+    "best_prior",
+    "gate_evidence",
+    "render_table",
+    "WARN_PCT",
+    "FAIL_PCT",
+]
+
+WARN_PCT = 10.0
+FAIL_PCT = 25.0
+
+# Metric keys where HIGHER is better; everything else (ms latencies,
+# overhead multipliers) regresses upward.  Units double-check this: any
+# per-second unit is a throughput.
+_HIGHER_IS_BETTER = ("throughput",)
+
+# Lines that are run diagnostics, not config measurements.
+_NON_CONFIG_METRICS = frozenset(
+    {
+        "bench_platform",
+        "bench_error",
+        "bench_failures",
+        "bench_evidence_gap",
+        "backend_probe",
+        "tpu_reprobe",
+        "adaptive_cutover_calibration",
+        "trace_export",
+    }
+)
+
+
+def higher_is_better(metric: str, unit: Optional[str]) -> bool:
+    if unit and "/s" in unit:
+        return True
+    return any(tag in metric for tag in _HIGHER_IS_BETTER)
+
+
+def parse_artifact(path: str) -> List[dict]:
+    """Parse one evidence artifact (driver wrapper JSON or raw JSONL)."""
+    with open(path) as fh:
+        text = fh.read()
+    lines: List[dict] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        text = doc["tail"]
+    elif isinstance(doc, dict):
+        return [doc]
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            line = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(line, dict) and "metric" in line:
+            lines.append(line)
+    return lines
+
+
+def artifact_backend(lines: Iterable[dict]) -> str:
+    """``tpu`` or ``cpu-fallback`` for a parsed artifact.
+
+    New-schema lines carry an explicit ``backend`` field; older rounds are
+    inferred from their ``bench_platform`` line (absence of one — or a
+    CPU/fallback platform — means no TPU evidence).
+    """
+    lines = list(lines)
+    for line in lines:
+        backend = line.get("backend")
+        if backend in ("tpu", "cpu-fallback"):
+            return backend
+    for line in lines:
+        if line.get("metric") == "bench_platform":
+            platform = str(line.get("value", ""))
+            return "tpu" if platform in ("tpu", "axon") else "cpu-fallback"
+    return "cpu-fallback"
+
+
+def config_lines(lines: Iterable[dict]) -> Dict[str, dict]:
+    """metric-key -> line for the measurement lines of one artifact."""
+    out: Dict[str, dict] = {}
+    for line in lines:
+        metric = line.get("metric")
+        if metric in _NON_CONFIG_METRICS or metric is None:
+            continue
+        # Last line per key wins (a re-run within one artifact supersedes).
+        if isinstance(line.get("value"), (int, float)):
+            out[metric] = line
+        else:
+            out.setdefault(metric, line)
+    return out
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def best_prior(
+    repo_dir: str, backend: str, exclude: Tuple[str, ...] = ()
+) -> Dict[str, Tuple[float, str, dict]]:
+    """Best prior value per config on ``backend`` across ``BENCH_r*.json``.
+
+    Returns ``{config: (value, source_name, line)}`` where *best* is
+    direction-aware (lowest latency / highest throughput recorded by any
+    prior round on the same backend).
+    """
+    best: Dict[str, Tuple[float, str, dict]] = {}
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")), key=_round_of)
+    for path in paths:
+        name = os.path.basename(path)
+        if name in exclude:
+            continue
+        try:
+            lines = parse_artifact(path)
+        except OSError:
+            continue
+        if artifact_backend(lines) != backend:
+            continue
+        for metric, line in config_lines(lines).items():
+            value = line.get("value")
+            if not isinstance(value, (int, float)):
+                continue
+            prior = best.get(metric)
+            better = higher_is_better(metric, line.get("unit"))
+            if (
+                prior is None
+                or (better and value > prior[0])
+                or (not better and value < prior[0])
+            ):
+                best[metric] = (float(value), name, line)
+    return best
+
+
+@dataclass
+class GateResult:
+    config: str
+    backend: str
+    status: str  # "pass" | "warn" | "fail" | "info"
+    fresh: Optional[float]
+    prior: Optional[float]
+    prior_source: str
+    change_pct: Optional[float]  # signed; positive = regression
+    note: str = ""
+
+
+def gate_evidence(
+    fresh_lines: Iterable[dict],
+    repo_dir: str = ".",
+    *,
+    backend: Optional[str] = None,
+    warn_pct: float = WARN_PCT,
+    fail_pct: float = FAIL_PCT,
+    exclude: Tuple[str, ...] = (),
+) -> List[GateResult]:
+    """Compare a fresh evidence artifact against the best prior rounds.
+
+    ``exclude`` names ``BENCH_r*.json`` basenames to drop from the prior
+    pool (a fresh artifact that IS one of them must not compare against
+    itself).
+    """
+    fresh_lines = list(fresh_lines)
+    if backend is None:
+        backend = artifact_backend(fresh_lines)
+    fresh = config_lines(fresh_lines)
+    prior = best_prior(repo_dir, backend, exclude=exclude)
+    results: List[GateResult] = []
+    for config in sorted(set(fresh) | set(prior)):
+        fresh_line = fresh.get(config)
+        fresh_value = fresh_line.get("value") if fresh_line else None
+        prior_hit = prior.get(config)
+        if prior_hit is None:
+            results.append(
+                GateResult(
+                    config,
+                    backend,
+                    "info",
+                    fresh_value,
+                    None,
+                    "-",
+                    None,
+                    note="no prior evidence on this backend (first measurement)",
+                )
+            )
+            continue
+        prior_value, source, prior_line = prior_hit
+        if not isinstance(fresh_value, (int, float)):
+            results.append(
+                GateResult(
+                    config,
+                    backend,
+                    "warn",
+                    None,
+                    prior_value,
+                    source,
+                    None,
+                    note=str(
+                        (fresh_line or {}).get("note")
+                        or (fresh_line or {}).get("error")
+                        or "config produced no measurement this run"
+                    )[:80],
+                )
+            )
+            continue
+        better = higher_is_better(config, prior_line.get("unit"))
+        if prior_value == 0:
+            change = 0.0
+        elif better:
+            change = (prior_value - fresh_value) / abs(prior_value) * 100.0
+        else:
+            change = (fresh_value - prior_value) / abs(prior_value) * 100.0
+        if change > fail_pct:
+            status = "fail"
+        elif change > warn_pct:
+            status = "warn"
+        else:
+            status = "pass"
+        results.append(
+            GateResult(
+                config,
+                backend,
+                status,
+                float(fresh_value),
+                prior_value,
+                source,
+                round(change, 1),
+            )
+        )
+    return results
+
+
+def render_table(results: List[GateResult]) -> str:
+    """Fixed-width pass/warn/fail table for terminals and CI logs."""
+    headers = ("config", "backend", "fresh", "best prior", "source", "Δ%", "status")
+    rows = [headers]
+    for r in results:
+        rows.append(
+            (
+                r.config,
+                r.backend,
+                "-" if r.fresh is None else f"{r.fresh:g}",
+                "-" if r.prior is None else f"{r.prior:g}",
+                r.prior_source,
+                "-" if r.change_pct is None else f"{r.change_pct:+.1f}",
+                r.status.upper() + (f"  ({r.note})" if r.note else ""),
+            )
+        )
+    widths = [
+        max(len(row[i]) for row in rows) for i in range(len(headers) - 1)
+    ]
+    out = []
+    for i, row in enumerate(rows):
+        line = "  ".join(
+            cell.ljust(widths[j]) for j, cell in enumerate(row[:-1])
+        )
+        out.append(line + "  " + row[-1])
+        if i == 0:
+            out.append("-" * len(out[0]))
+    return "\n".join(out)

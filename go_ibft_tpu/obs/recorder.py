@@ -1,0 +1,68 @@
+"""Fixed-size ring buffer for finished spans and instant events.
+
+The flight-recorder storage: a preallocated ring of record tuples guarded
+by one lock.  Appends are O(1) and never allocate beyond the tuple being
+stored; when the ring is full the oldest records are overwritten — a
+flight recorder keeps the *latest* window, which is the one that explains
+a hang or a slow drain.  Nothing here imports JAX, asyncio, or any other
+framework: the recorder must be safe to call from transport threads,
+worker pools, and the engine's event loop alike.
+
+Record layout (plain tuples — cheap to create, cheap to drain)::
+
+    (ph, name, track, ts_us, dur_us, args)
+
+``ph`` is the Chrome trace-event phase this record exports as: ``"X"``
+(complete span) or ``"i"`` (instant).  ``ts_us`` is a monotonic
+microsecond timestamp (``time.perf_counter_ns() // 1000`` — one shared
+clock for every record, so cross-track ordering is meaningful).  ``args``
+is a (possibly empty) dict of span attributes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+Record = Tuple[str, str, str, int, int, Optional[dict]]
+
+DEFAULT_CAPACITY = 65536
+
+
+class RingRecorder:
+    """Thread-safe fixed-capacity ring of trace records."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._buf: List[Optional[Record]] = [None] * capacity
+        self._next = 0  # total records ever appended
+        self.dropped = 0  # records overwritten after the ring filled
+
+    def append(self, record: Record) -> None:
+        with self._lock:
+            i = self._next % self.capacity
+            if self._buf[i] is not None:
+                self.dropped += 1
+            self._buf[i] = record
+            self._next += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._next, self.capacity)
+
+    def snapshot(self) -> List[Record]:
+        """All retained records, oldest first (does not clear)."""
+        with self._lock:
+            if self._next <= self.capacity:
+                return [r for r in self._buf[: self._next] if r is not None]
+            i = self._next % self.capacity
+            return [r for r in self._buf[i:] + self._buf[:i] if r is not None]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._next = 0
+            self.dropped = 0

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..core.validator_manager import calculate_quorum
 from ..crypto import ecdsa as host_ecdsa
+from ..obs import trace
 from ..crypto.keccak import keccak256, keccak256_many
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import IbftMessage
@@ -125,20 +126,39 @@ class HostBatchVerifier:
 
     def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
         out = np.zeros(len(msgs), dtype=bool)
-        for i, msg in enumerate(msgs):
-            if msg.view is None or len(msg.sender) != ADDRESS_BYTES:
-                continue
-            if len(msg.signature) != SIG_BYTES:
-                continue
-            r, s, v = split_signature(msg.signature)
-            digest = keccak256(msg.encode(include_signature=False))
-            pub = self._recover(digest, r, s, v)
-            if pub is None:
-                continue
-            out[i] = (
-                host_ecdsa.pubkey_to_address(*pub) == msg.sender
-                and self._is_member(msg.view.height, msg.sender)
-            )
+        with trace.span(
+            "verify.drain", kind="senders", route="host", lanes=len(msgs)
+        ):
+            # The flight-recorder phase structure mirrors the device path
+            # (pack -> dispatch -> device-wait -> quorum) so every drain
+            # renders the same way regardless of route; on the synchronous
+            # host route "dispatch" is the recover loop and the wait is
+            # empty by construction.
+            with trace.span("verify.pack", lanes=len(msgs)):
+                prepared = []
+                for i, msg in enumerate(msgs):
+                    if msg.view is None or len(msg.sender) != ADDRESS_BYTES:
+                        continue
+                    if len(msg.signature) != SIG_BYTES:
+                        continue
+                    r, s, v = split_signature(msg.signature)
+                    digest = keccak256(msg.encode(include_signature=False))
+                    prepared.append((i, msg, digest, r, s, v))
+            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
+                recovered = [
+                    (i, msg, self._recover(digest, r, s, v))
+                    for i, msg, digest, r, s, v in prepared
+                ]
+            with trace.span("verify.device_wait", route="host"):
+                pass  # nothing in flight on the synchronous route
+            with trace.span("verify.quorum", lanes=len(recovered)):
+                for i, msg, pub in recovered:
+                    if pub is None:
+                        continue
+                    out[i] = (
+                        host_ecdsa.pubkey_to_address(*pub) == msg.sender
+                        and self._is_member(msg.view.height, msg.sender)
+                    )
         return out
 
     def verify_committed_seals(
@@ -150,17 +170,33 @@ class HostBatchVerifier:
         # recover also reads exactly 32 digest bytes).
         if len(proposal_hash) != 32:
             return out
-        for i, seal in enumerate(seals):
-            if len(seal.signer) != ADDRESS_BYTES or len(seal.signature) != SIG_BYTES:
-                continue
-            r, s, v = split_signature(seal.signature)
-            pub = self._recover(proposal_hash, r, s, v)
-            if pub is None:
-                continue
-            out[i] = (
-                host_ecdsa.pubkey_to_address(*pub) == seal.signer
-                and self._is_member(height, seal.signer)
-            )
+        with trace.span(
+            "verify.drain", kind="seals", route="host", lanes=len(seals)
+        ):
+            with trace.span("verify.pack", lanes=len(seals)):
+                prepared = []
+                for i, seal in enumerate(seals):
+                    if (
+                        len(seal.signer) != ADDRESS_BYTES
+                        or len(seal.signature) != SIG_BYTES
+                    ):
+                        continue
+                    prepared.append((i, seal, *split_signature(seal.signature)))
+            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
+                recovered = [
+                    (i, seal, self._recover(proposal_hash, r, s, v))
+                    for i, seal, r, s, v in prepared
+                ]
+            with trace.span("verify.device_wait", route="host"):
+                pass  # nothing in flight on the synchronous route
+            with trace.span("verify.quorum", lanes=len(recovered)):
+                for i, seal, pub in recovered:
+                    if pub is None:
+                        continue
+                    out[i] = (
+                        host_ecdsa.pubkey_to_address(*pub) == seal.signer
+                        and self._is_member(height, seal.signer)
+                    )
         return out
 
 
@@ -724,34 +760,40 @@ class DeviceBatchVerifier:
         blocking — JAX async dispatch lets the caller pack the next batch
         while this one executes (:mod:`go_ibft_tpu.verify.pipeline`).
         """
-        zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
-        if quorum_args is None:
-            return (
-                _recover_kernel(zw, r, s, v, claimed, jnp.asarray(table), live),
-                None,
+        with trace.span("verify.dispatch", route="device"):
+            zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
+            if quorum_args is None:
+                return (
+                    _recover_kernel(
+                        zw, r, s, v, claimed, jnp.asarray(table), live
+                    ),
+                    None,
+                )
+            plo, phi, thr = quorum_args
+            mask, reached_dev, _, _ = _certify_kernel(
+                zw,
+                r,
+                s,
+                v,
+                claimed,
+                jnp.asarray(table),
+                live,
+                jnp.asarray(plo),
+                jnp.asarray(phi),
+                jnp.int32(max(thr, 0) & 0xFFFF),
+                jnp.int32(max(thr, 0) >> 16),
             )
-        plo, phi, thr = quorum_args
-        mask, reached_dev, _, _ = _certify_kernel(
-            zw,
-            r,
-            s,
-            v,
-            claimed,
-            jnp.asarray(table),
-            live,
-            jnp.asarray(plo),
-            jnp.asarray(phi),
-            jnp.int32(max(thr, 0) & 0xFFFF),
-            jnp.int32(max(thr, 0) >> 16),
-        )
-        return mask, reached_dev
+            return mask, reached_dev
 
     @staticmethod
     def _readback(handle) -> Tuple[np.ndarray, Optional[bool]]:
         """Block on one :meth:`_dispatch_async` handle -> host results."""
         mask_dev, reached_dev = handle
-        mask = np.asarray(mask_dev)
-        reached = None if reached_dev is None else bool(np.asarray(reached_dev))
+        with trace.span("verify.device_wait", route="device"):
+            mask = np.asarray(mask_dev)
+            reached = (
+                None if reached_dev is None else bool(np.asarray(reached_dev))
+            )
         return mask, reached
 
     def _dispatch(self, inputs, table, quorum_args, metric: str):
@@ -770,6 +812,10 @@ class DeviceBatchVerifier:
     _MAX_DEVICE_PAYLOAD = _BLOCK_BUCKETS[-1] * dk.RATE_BYTES - 1
 
     def _sender_inputs(self, msgs: List[IbftMessage], pad_lanes: int = 0):
+        with trace.span("verify.pack", kind="senders", lanes=len(msgs)):
+            return self._sender_inputs_impl(msgs, pad_lanes)
+
+    def _sender_inputs_impl(self, msgs: List[IbftMessage], pad_lanes: int = 0):
         """Pack envelopes; digest on device, oversize payloads on host.
 
         A payload above the largest keccak block bucket (a PREPREPARE
@@ -818,7 +864,8 @@ class DeviceBatchVerifier:
         return zw, r, s, v, senders, live
 
     def _seal_inputs(self, proposal_hash: bytes, seals: List[CommittedSeal]):
-        return pack_seal_batch(proposal_hash, seals)
+        with trace.span("verify.pack", kind="seals", lanes=len(seals)):
+            return pack_seal_batch(proposal_hash, seals)
 
     # -- fused mask + quorum (the engine's phase hot path) --------------
 
@@ -853,13 +900,17 @@ class DeviceBatchVerifier:
         ]
         if not idxs:
             return out, thr <= 0
-        mask, reached = self._dispatch(
-            self._sender_inputs([msgs[i] for i in idxs]),
-            table,
-            qargs,
-            "certify_senders_ms",
-        )
-        out[np.asarray(idxs)] = mask[: len(idxs)]
+        with trace.span(
+            "verify.drain", route="device", kind="certify_senders", lanes=len(idxs)
+        ):
+            mask, reached = self._dispatch(
+                self._sender_inputs([msgs[i] for i in idxs]),
+                table,
+                qargs,
+                "certify_senders_ms",
+            )
+            with trace.span("verify.quorum", route="device-fused"):
+                out[np.asarray(idxs)] = mask[: len(idxs)]
         return out, reached
 
     def certify_seals(
@@ -877,13 +928,17 @@ class DeviceBatchVerifier:
         idxs = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
         if not idxs or len(proposal_hash) != 32:
             return out, thr <= 0
-        mask, reached = self._dispatch(
-            self._seal_inputs(proposal_hash, [seals[i] for i in idxs]),
-            table,
-            qargs,
-            "certify_seals_ms",
-        )
-        out[np.asarray(idxs)] = mask[: len(idxs)]
+        with trace.span(
+            "verify.drain", route="device", kind="certify_seals", lanes=len(idxs)
+        ):
+            mask, reached = self._dispatch(
+                self._seal_inputs(proposal_hash, [seals[i] for i in idxs]),
+                table,
+                qargs,
+                "certify_seals_ms",
+            )
+            with trace.span("verify.quorum", route="device-fused"):
+                out[np.asarray(idxs)] = mask[: len(idxs)]
         return out, reached
 
     def certify_round(
@@ -936,40 +991,48 @@ class DeviceBatchVerifier:
             _bucket(len(midx), _BATCH_BUCKETS), _bucket(len(sidx), _BATCH_BUCKETS)
         )
         t0 = time.perf_counter()
-        zw1, r1, s1, v1, senders, live1 = self._sender_inputs(
-            [msgs[i] for i in midx], pad_lanes=lanes
-        )
-        hz, r2, s2, v2, signers, live2 = pack_seal_batch(
-            proposal_hash, [seals[i] for i in sidx], pad_lanes=lanes
-        )
-        mask, p_reached, s_reached = _round_kernel(
-            jnp.concatenate([jnp.asarray(zw1), jnp.asarray(hz)], axis=0),
-            jnp.concatenate([jnp.asarray(r1), jnp.asarray(r2)], axis=0),
-            jnp.concatenate([jnp.asarray(s1), jnp.asarray(s2)], axis=0),
-            jnp.concatenate([jnp.asarray(v1), jnp.asarray(v2)], axis=0),
-            jnp.concatenate([jnp.asarray(senders), jnp.asarray(signers)], axis=0),
-            jnp.asarray(table),
-            jnp.concatenate([jnp.asarray(live1), jnp.asarray(live2)], axis=0),
-            jnp.asarray(plo),
-            jnp.asarray(phi),
-            jnp.int32(max(p_thr, 0) & 0xFFFF),
-            jnp.int32(max(p_thr, 0) >> 16),
-            jnp.int32(max(seal_thr, 0) & 0xFFFF),
-            jnp.int32(max(seal_thr, 0) >> 16),
-        )
-        mask = np.asarray(mask)
+        with trace.span(
+            "verify.drain", route="device", kind="certify_round", lanes=lanes
+        ):
+            zw1, r1, s1, v1, senders, live1 = self._sender_inputs(
+                [msgs[i] for i in midx], pad_lanes=lanes
+            )
+            with trace.span("verify.pack", kind="seals", lanes=len(sidx)):
+                hz, r2, s2, v2, signers, live2 = pack_seal_batch(
+                    proposal_hash, [seals[i] for i in sidx], pad_lanes=lanes
+                )
+            with trace.span("verify.dispatch", route="device"):
+                mask, p_reached, s_reached = _round_kernel(
+                    jnp.concatenate([jnp.asarray(zw1), jnp.asarray(hz)], axis=0),
+                    jnp.concatenate([jnp.asarray(r1), jnp.asarray(r2)], axis=0),
+                    jnp.concatenate([jnp.asarray(s1), jnp.asarray(s2)], axis=0),
+                    jnp.concatenate([jnp.asarray(v1), jnp.asarray(v2)], axis=0),
+                    jnp.concatenate(
+                        [jnp.asarray(senders), jnp.asarray(signers)], axis=0
+                    ),
+                    jnp.asarray(table),
+                    jnp.concatenate(
+                        [jnp.asarray(live1), jnp.asarray(live2)], axis=0
+                    ),
+                    jnp.asarray(plo),
+                    jnp.asarray(phi),
+                    jnp.int32(max(p_thr, 0) & 0xFFFF),
+                    jnp.int32(max(p_thr, 0) >> 16),
+                    jnp.int32(max(seal_thr, 0) & 0xFFFF),
+                    jnp.int32(max(seal_thr, 0) >> 16),
+                )
+            with trace.span("verify.device_wait", route="device"):
+                mask = np.asarray(mask)
+            with trace.span("verify.quorum", route="device-fused"):
+                sender_mask[np.asarray(midx)] = mask[: len(midx)]
+                seal_mask[np.asarray(sidx)] = mask[lanes : lanes + len(sidx)]
+                p_ok = bool(np.asarray(p_reached))
+                s_ok = bool(np.asarray(s_reached))
         metrics.observe(
             ("go-ibft", "device", "certify_round_ms"),
             (time.perf_counter() - t0) * 1e3,
         )
-        sender_mask[np.asarray(midx)] = mask[: len(midx)]
-        seal_mask[np.asarray(sidx)] = mask[lanes : lanes + len(sidx)]
-        return (
-            sender_mask,
-            bool(np.asarray(p_reached)),
-            seal_mask,
-            bool(np.asarray(s_reached)),
-        )
+        return sender_mask, p_ok, seal_mask, s_ok
 
     # -- BatchVerifier protocol ----------------------------------------
 
@@ -1023,10 +1086,16 @@ class DeviceBatchVerifier:
                 self._table_dev(height),
             )
 
-        for (_, chunk), mask in self._run_chunk_pipeline(
-            items, pack, "verify_senders_ms"
+        with trace.span(
+            "verify.drain", route="device", kind="senders", chunks=len(items)
         ):
-            out[np.asarray(chunk)] = mask[: len(chunk)]
+            results = self._run_chunk_pipeline(items, pack, "verify_senders_ms")
+            # Mask-only drain: the voting-power reduction proper runs in
+            # the caller (engine exact ints); this phase is the per-lane
+            # verdict assembly.
+            with trace.span("verify.quorum", route="mask"):
+                for (_, chunk), mask in results:
+                    out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
     def verify_committed_seals(
@@ -1048,10 +1117,13 @@ class DeviceBatchVerifier:
                 self._table_dev(height),
             )
 
-        for chunk, mask in self._run_chunk_pipeline(
-            items, pack, "verify_seals_ms"
+        with trace.span(
+            "verify.drain", route="device", kind="seals", chunks=len(items)
         ):
-            out[np.asarray(chunk)] = mask[: len(chunk)]
+            results = self._run_chunk_pipeline(items, pack, "verify_seals_ms")
+            with trace.span("verify.quorum", route="mask"):
+                for chunk, mask in results:
+                    out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
     def verify_round_chunked(
@@ -1101,11 +1173,14 @@ class DeviceBatchVerifier:
                 )
             return item, inputs, self._table_dev(height)
 
-        for (kind, chunk), mask in self._run_chunk_pipeline(
-            items, pack, "round_drain_ms"
+        with trace.span(
+            "verify.drain", route="device", kind="round_chunked", chunks=len(items)
         ):
-            target = sender_mask if kind == "sender" else seal_mask
-            target[np.asarray(chunk)] = mask[: len(chunk)]
+            results = self._run_chunk_pipeline(items, pack, "round_drain_ms")
+            with trace.span("verify.quorum", route="mask"):
+                for (kind, chunk), mask in results:
+                    target = sender_mask if kind == "sender" else seal_mask
+                    target[np.asarray(chunk)] = mask[: len(chunk)]
         return sender_mask, seal_mask
 
 
@@ -1338,16 +1413,17 @@ class AdaptiveBatchVerifier:
     def _host_reached(
         self, valid_addrs: Iterable[bytes], height: int, threshold: Optional[int]
     ) -> bool:
-        powers = self._validators(height)
-        thr = (
-            calculate_quorum(sum(powers.values()))
-            if threshold is None
-            else threshold
-        )
-        if thr <= 0:
-            return True
-        got = sum(powers.get(a, 0) for a in set(valid_addrs))
-        return got >= thr
+        with trace.span("verify.quorum", route="host-int"):
+            powers = self._validators(height)
+            thr = (
+                calculate_quorum(sum(powers.values()))
+                if threshold is None
+                else threshold
+            )
+            if thr <= 0:
+                return True
+            got = sum(powers.get(a, 0) for a in set(valid_addrs))
+            return got >= thr
 
     # -- BatchVerifier ---------------------------------------------------
 

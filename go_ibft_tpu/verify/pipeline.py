@@ -50,6 +50,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace
 from ..utils import metrics
 
 __all__ = [
@@ -194,6 +195,9 @@ class CircuitBreaker:
         metrics.inc_counter(("go-ibft", "breaker", kind))
         metrics.observe(BREAKER_TRANSITIONS_KEY, float(new_level))
         metrics.set_gauge(BREAKER_LEVEL_KEY, float(new_level))
+        trace.instant(
+            "breaker.transition", kind=kind, level=self.levels[new_level]
+        )
 
 
 def observe_overlap_efficiency(serial_s: float, pipelined_s: float) -> float:
@@ -266,7 +270,8 @@ class VerifyPipeline:
             nonlocal wait_s
             idx, handle = inflight.popleft()
             t0 = time.perf_counter()
-            results[idx] = readback(handle)
+            with trace.span("pipeline.readback", item=idx):
+                results[idx] = readback(handle)
             dt = time.perf_counter() - t0
             wait_s += dt
             metrics.observe(READBACK_WAIT_MS_KEY, dt * 1e3)
@@ -274,13 +279,15 @@ class VerifyPipeline:
         try:
             for i, item in enumerate(items):
                 t0 = time.perf_counter()
-                packed = pack(item)
+                with trace.span("pipeline.pack", item=i):
+                    packed = pack(item)
                 dt = time.perf_counter() - t0
                 pack_s += dt
                 metrics.observe(PACK_MS_KEY, dt * 1e3)
 
                 t0 = time.perf_counter()
-                inflight.append((i, dispatch(packed)))
+                with trace.span("pipeline.dispatch", item=i):
+                    inflight.append((i, dispatch(packed)))
                 dispatch_s += time.perf_counter() - t0
 
                 while len(inflight) >= self.depth:

@@ -148,10 +148,29 @@ def main() -> int:
         default=None,
         help="JSON FaultConfig overrides (copy off the CHAOS-REPLAY line)",
     )
+    parser.add_argument(
+        "--trace",
+        metavar="OUT_JSON",
+        default=None,
+        help="record the soak on the flight recorder and export a "
+        "Chrome/Perfetto trace (per-node tracks + chaos.* injection "
+        "instants) at exit",
+    )
     args = parser.parse_args()
     overrides = json.loads(args.config) if args.config else {}
     config = FaultConfig(**{**DEFAULT_CONFIG, **overrides})
-    return asyncio.run(replay(args.seed, args.heights, args.nodes, config))
+    if args.trace:
+        from go_ibft_tpu.obs import trace as obs_trace
+
+        obs_trace.enable()
+    try:
+        return asyncio.run(replay(args.seed, args.heights, args.nodes, config))
+    finally:
+        if args.trace:
+            from go_ibft_tpu.obs.export import write_chrome_trace
+
+            n = write_chrome_trace(args.trace)
+            print(f"trace: {args.trace} ({n} events)", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Regression-gate report: fresh bench evidence vs the best prior rounds.
+
+Usage::
+
+    python scripts/obs_report.py [--evidence bench_evidence.jsonl]
+                                 [--repo .] [--fail-on fail|warn|never]
+
+Parses the fresh evidence file (``bench.py``'s per-config JSONL — or any
+``BENCH_r*.json`` driver artifact), determines its backend
+(``tpu`` vs ``cpu-fallback``), compares each config against the best
+prior ``BENCH_r*.json`` value recorded on the SAME backend, and prints a
+pass/warn/fail table (``go_ibft_tpu.obs.gates``).  Exit code: 0 unless a
+row at or above ``--fail-on`` severity exists (default ``fail``); 2 when
+the evidence file is missing/unreadable.
+
+``make obs-report`` runs this with defaults.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from go_ibft_tpu.obs import gates  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--evidence",
+        default="bench_evidence.jsonl",
+        help="fresh evidence file (bench.py JSONL or BENCH_r*.json wrapper)",
+    )
+    parser.add_argument(
+        "--repo", default=".", help="repo root holding prior BENCH_r*.json"
+    )
+    parser.add_argument(
+        "--fail-on",
+        choices=("fail", "warn", "never"),
+        default="fail",
+        help="lowest severity that makes the exit code nonzero",
+    )
+    args = parser.parse_args()
+
+    try:
+        fresh = gates.parse_artifact(args.evidence)
+    except OSError as err:
+        print(
+            f"obs_report: cannot read {args.evidence!r} ({err}); "
+            "run `python bench.py` (or `make bench`) first",
+            file=sys.stderr,
+        )
+        return 2
+    if not fresh:
+        print(
+            f"obs_report: {args.evidence!r} holds no metric lines", file=sys.stderr
+        )
+        return 2
+
+    # Exclude the fresh file from the prior pool if it IS a BENCH_r*.json.
+    backend = gates.artifact_backend(fresh)
+    results = gates.gate_evidence(
+        fresh,
+        args.repo,
+        backend=backend,
+        exclude=(os.path.basename(args.evidence),),
+    )
+
+    print(f"evidence: {args.evidence}  backend: {backend}")
+    print(gates.render_table(results))
+    statuses = {r.status for r in results}
+    bad = {"fail"} if args.fail_on == "fail" else {"fail", "warn"}
+    if args.fail_on != "never" and statuses & bad:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

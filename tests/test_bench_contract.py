@@ -33,20 +33,30 @@ def test_headline_key_reserved_for_target_platform():
     assert "fallback" in bench.headline_metric(True)
 
 
-def test_fallback_flags_error_but_exits_by_crashes():
-    """Static check: main()'s fallback branch logs a 'bench_error' line
-    (the degradation flag) yet exits 0 when every runnable config
-    completed — nonzero rc is reserved for configs that crashed
-    (VERDICT r5 weak #4)."""
+def test_fallback_flags_error_but_exits_by_evidence_and_crashes():
+    """Static check: the fallback branch logs a 'bench_error' line (the
+    degradation flag) yet exits 0 when every config produced evidence and
+    none crashed — rc=0 is reserved STRICTLY for full evidence coverage
+    (ISSUE 4), nonzero for crashes or evidence gaps, never for platform
+    degradation alone."""
     tree = ast.parse(pathlib.Path(bench.__file__).read_text())
-    main_fn = next(
-        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "main"
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
     )
-    src = ast.unparse(main_fn)
+    src = ast.unparse(run_fn)
     assert "bench_error" in src
-    assert "sys.exit(1 if failures else 0)" in src
-    # the degradation flag + crash-driven exit are guarded by the fallback flag
+    # the degradation flag + evidence-driven exit are guarded by the
+    # fallback flag; both branches route through the shared _finish
     assert "_FALLBACK" in src
+    assert src.count("_finish(failures)") == 2
+    finish_fn = next(
+        n
+        for n in tree.body
+        if isinstance(n, ast.FunctionDef) and n.name == "_finish"
+    )
+    fsrc = ast.unparse(finish_fn)
+    assert "sys.exit(1 if failures or missing else 0)" in fsrc
+    assert "bench_evidence_gap" in fsrc
 
 
 _FIVE_CONFIG_KEYS = (
@@ -60,17 +70,25 @@ _FIVE_CONFIG_KEYS = (
 
 
 @pytest.fixture(scope="module")
-def driver_run():
+def driver_run(tmp_path_factory):
     """ONE driver-conditions bench run shared by the contract asserts:
     fresh subprocess, cold function caches — what the round driver
     executes.  The CPU backend is pinned explicitly: these asserts pin the
     FALLBACK contract (the acceptance text says "on the CPU backend"), and
     on a host with a live TPU an unpinned run would take the non-fallback
-    path — minutes of cold device compiles and a different line set."""
+    path — minutes of cold device compiles and a different line set.
+
+    The run captures the full evidence surface: ``--trace`` exports the
+    flight-recorder timeline and the evidence JSONL lands in a tmp dir
+    (probe fingerprint cache isolated there too, so the suite never
+    pollutes — or is served by — the operator's ~/.cache verdict)."""
     import os
 
+    tmp = tmp_path_factory.mktemp("bench_evidence")
+    trace_path = tmp / "trace.json"
+    evidence_path = tmp / "bench_evidence.jsonl"
     proc = subprocess.run(
-        [sys.executable, "bench.py"],
+        [sys.executable, "bench.py", "--trace", str(trace_path)],
         cwd=pathlib.Path(bench.__file__).parent,
         capture_output=True,
         text=True,
@@ -80,7 +98,11 @@ def driver_run():
         # be killed mid-run by the 600s timeout on a host without the
         # native verifier, losing every diagnostic line.
         env=dict(
-            os.environ, JAX_PLATFORMS="cpu", GO_IBFT_BENCH_BUDGET_S="480"
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            GO_IBFT_BENCH_BUDGET_S="480",
+            GO_IBFT_EVIDENCE_PATH=str(evidence_path),
+            GO_IBFT_PROBE_CACHE=str(tmp / "probe.json"),
         ),
     )
     lines = [
@@ -88,7 +110,11 @@ def driver_run():
         for line in proc.stdout.splitlines()
         if line.startswith("{")
     ]
-    return proc, {line["metric"]: line for line in lines if "metric" in line}
+    return (
+        proc,
+        {line["metric"]: line for line in lines if "metric" in line},
+        {"trace": trace_path, "evidence": evidence_path},
+    )
 
 
 def test_driver_conditions_all_configs_measure(driver_run):
@@ -96,7 +122,7 @@ def test_driver_conditions_all_configs_measure(driver_run):
     backend — no 'skipped on CPU fallback' placeholders (rounds 1-5 never
     saw configs #3-#5 complete on any backend), and rc is 0 because
     completing on a fallback platform is not a crash."""
-    proc, by_metric = driver_run
+    proc, by_metric, _ = driver_run
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for key in _FIVE_CONFIG_KEYS:
         line = by_metric.get(key)
@@ -121,7 +147,7 @@ def test_driver_conditions_config3_pipelined_packing_evidence(driver_run):
     floor is pinned when the native verifier is present (the no-native
     path scales n down to 8, where per-call overhead dominates the
     lanes/s figure)."""
-    _, by_metric = driver_run
+    _, by_metric, _ = driver_run
     line = by_metric["ecdsa_1000v_10h_pipelined_throughput"]
     assert line["pack_ms"] > 0, line
     assert "pipeline_speedup" in line and "overlap_efficiency" in line, line
@@ -138,7 +164,7 @@ def test_driver_conditions_happy_path_parity(driver_run):
     adaptive engine must at least break even against the forced sequential
     host cluster (>= 0.95x; r05 recorded 0.86x before the ingress-window
     and measurement-discipline fixes)."""
-    _, by_metric = driver_run
+    _, by_metric, _ = driver_run
     line = by_metric["happy_path_4v_height_latency"]
     assert line["vs_baseline"] >= 0.95, line
 
@@ -181,6 +207,102 @@ def test_guarded_skips_config_when_budget_reserved(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_BUDGET_S", 10**9)
     bench._guarded(config, failures, reserve_s=10.0)
     assert calls == [1]
+
+
+def test_driver_conditions_evidence_schema(driver_run):
+    """The evidence JSONL contract (ISSUE 4 satellite): exactly one
+    append-only line per BASELINE.md config (diagnostics lines ride along
+    but never replace one), every line carrying the required schema
+    fields, with backend/probe provenance matching the CPU-pinned run.
+    Timestamps are monotone non-decreasing — the flush-per-record
+    append-only discipline observable from the artifact itself."""
+    from go_ibft_tpu.obs.evidence import REQUIRED_EVIDENCE_FIELDS
+
+    _, _, artifacts = driver_run
+    raw = artifacts["evidence"].read_text().splitlines()
+    lines = [json.loads(line) for line in raw if line.strip()]
+    assert lines, "evidence file is empty"
+    by_config = {}
+    for line in lines:
+        for field in REQUIRED_EVIDENCE_FIELDS:
+            assert field in line, (field, line)
+        assert line["backend"] == "cpu-fallback", line
+        assert line["probe"] in ("ok", "cached", "timeout", "error"), line
+        by_config.setdefault(line["config"], []).append(line)
+    for key in _FIVE_CONFIG_KEYS:
+        assert key in by_config, (key, sorted(by_config))
+        assert len(by_config[key]) == 1, by_config[key]
+    ts = [line["ts"] for line in lines]
+    assert ts == sorted(ts)
+
+
+def test_driver_conditions_trace_covers_every_drain(driver_run):
+    """``bench.py --trace`` emits a Chrome-trace JSON (schema-validated)
+    whose spans cover pack -> dispatch -> device-wait -> quorum for EVERY
+    verify drain of the run — config #1's happy path included (the
+    acceptance criterion's named phases, on the host route exactly like
+    the device route)."""
+    from tests.test_obs import _validate_trace_doc
+
+    _, _, artifacts = driver_run
+    doc = _validate_trace_doc(json.loads(artifacts["trace"].read_text()))
+    # The ring must not have wrapped: a truncated window orphans spans at
+    # the boundary, and the per-drain containment below is only meaningful
+    # over a complete record.
+    assert doc["otherData"]["droppedRecords"] == 0
+    events = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    by_tid = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    drains = [e for e in events if e["name"] == "verify.drain"]
+    assert drains, "no verify.drain spans recorded"
+    phases = {
+        "verify.pack",
+        "verify.dispatch",
+        "verify.device_wait",
+        "verify.quorum",
+    }
+    for drain in drains:
+        t0, t1 = drain["ts"], drain["ts"] + drain["dur"]
+        inside = {
+            e["name"]
+            for e in by_tid[drain["tid"]]
+            if e["ph"] == "X"
+            and e["name"] in phases
+            and e["ts"] >= t0
+            and e["ts"] + e["dur"] <= t1
+        }
+        assert inside == phases, (drain, inside)
+    # The engine phases render too: per-node tracks with round markers.
+    names = {e["name"] for e in events}
+    assert {"round.start", "prepare.drain", "commit.drain"} <= names
+
+
+def test_disabled_tracing_overhead_under_5pct(driver_run):
+    """The bench-contract pin on disabled-mode overhead: the driver run
+    above measured the happy path; a height crosses ~250 span sites
+    (counted from the traced run's events-per-height), so the per-site
+    disabled cost measured here must keep the instrumentation tax under
+    5% of the recorded height latency."""
+    import time as _time
+
+    from go_ibft_tpu.obs import trace as obs_trace
+
+    assert not obs_trace.enabled()
+    n = 100_000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        with obs_trace.span("bench.overhead", lanes=4):
+            pass
+    per_call_s = (_time.perf_counter() - t0) / n
+    _, by_metric, _ = driver_run
+    height_ms = by_metric["happy_path_4v_height_latency"]["value"]
+    spans_per_height = 250
+    overhead = per_call_s * spans_per_height
+    assert overhead < 0.05 * height_ms / 1e3, (
+        f"disabled tracing costs {overhead * 1e3:.3f}ms per ~{height_ms}ms "
+        f"height ({per_call_s * 1e9:.0f}ns/site x {spans_per_height} sites)"
+    )
 
 
 def test_single_shared_probe_knob():

@@ -1,7 +1,7 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
-	warm cluster-bench obs-report
+	warm cluster-bench obs-report chain-soak
 
 test:
 	python -m pytest tests/ -q
@@ -36,6 +36,12 @@ obs-report:
 # (CI slow tier runs this before pytest so no compile hits a test timeout)
 warm:
 	python scripts/warm_kernels.py
+
+# Chain-layer soaks: the tier-1 smoke plus the slow 30-node/20-height
+# ChainRunner soak under seeded chaos drops (tests/test_chain_soak.py)
+chain-soak:
+	python -m pytest tests/test_chain_soak.py tests/test_chain.py \
+		tests/test_chain_sync.py -q
 
 # Engine-level throughput: N-node cluster finalizing H heights
 cluster-bench:

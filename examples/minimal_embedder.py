@@ -111,6 +111,71 @@ async def main_async(
         for e in engines:
             e.messages.close()
 
+    _print_chains(engines)
+
+
+async def main_chain(
+    n: int, heights: int, use_device: bool, use_bls: bool = False
+) -> None:
+    """The continuous-node mode: one ChainRunner per validator.
+
+    Unlike :func:`main_async`'s per-height ``asyncio.gather`` barrier,
+    each node owns ONE persistent runner task that drives heights
+    back-to-back: finalized blocks and mid-round locks are WAL-persisted
+    (``wal-<i>.jsonl`` in a temp dir — point it at real storage in a
+    deployment and call ``runner.recover()`` on restart), a node that
+    falls behind rejoins via batched block-sync, and buffered next-height
+    traffic is pre-verified while the current height's COMMIT drain is in
+    flight.  See docs/CHAIN.md.
+    """
+    import os
+    import tempfile
+
+    from go_ibft_tpu.chain import (
+        ChainRunner,
+        LoopbackSyncNetwork,
+        SyncClient,
+        WriteAheadLog,
+    )
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    engines = build_cluster(n, use_device, use_bls)
+    network = LoopbackSyncNetwork()
+    runners = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, engine in enumerate(engines):
+            src = engine.backend.get_voting_powers
+            runner = ChainRunner(
+                engine,
+                WriteAheadLog(os.path.join(tmp, f"wal-{i}.jsonl")),
+                sync=SyncClient(
+                    engine.backend.id(),
+                    network,
+                    engine.batch_verifier or HostBatchVerifier(src),
+                    src,
+                ),
+            )
+            network.register(engine.backend.id(), runner)
+            runners.append(runner)
+        try:
+            await asyncio.gather(
+                *(r.run(until_height=heights) for r in runners)
+            )
+        finally:
+            for engine in engines:
+                engine.messages.close()
+        for i, runner in enumerate(runners):
+            stats = runner.stats()
+            print(
+                f"validator {i}: height={runner.latest_height()} "
+                f"handoff_ms_mean={stats['handoff_ms_mean']:.3f} "
+                f"overlapped_lanes={stats['overlapped_lanes']} "
+                f"synced={stats['synced_heights']}"
+            )
+    _print_chains(engines)
+
+
+def _print_chains(engines) -> None:
     for i, e in enumerate(engines):
         chain = [p.raw_proposal.decode() for p, _seals in e.backend.inserted]
         seals = len(e.backend.inserted[-1][1])
@@ -131,5 +196,13 @@ if __name__ == "__main__":
         action="store_true",
         help="BLS12-381 committed seals (one pairing certifies a quorum)",
     )
+    ap.add_argument(
+        "--chain",
+        action="store_true",
+        help="drive heights through ChainRunners (persistent per-node "
+        "height loops, WAL + block-sync) instead of the per-height "
+        "gather barrier",
+    )
     args = ap.parse_args()
-    asyncio.run(main_async(args.nodes, args.heights, args.device, args.bls))
+    runner = main_chain if args.chain else main_async
+    asyncio.run(runner(args.nodes, args.heights, args.device, args.bls))

@@ -15,6 +15,10 @@ A from-scratch re-design of the capability set of 0xPolygon/go-ibft
 - Scale: ``go_ibft_tpu.parallel`` shards verification batches over a
   ``jax.sharding.Mesh`` and provides a lock-step multi-validator cluster
   simulation where "multicast" is an all_gather over ICI.
+- Chain: ``go_ibft_tpu.chain`` turns the per-height engine into a
+  continuously running validator node — a persistent multi-height sequencer
+  with no inter-height barrier, WAL crash recovery, and batched block-sync
+  catch-up (docs/CHAIN.md).
 """
 
 __version__ = "0.1.0"

@@ -10,7 +10,10 @@ wrappers applies it at the three seams the engine already exposes:
   wire-encoding bit-flips (:class:`ChaoticDeliver`/:class:`ChaoticTransport`);
 * batch verifiers and crypto backends — slow verifies and simulated XLA
   ``RuntimeError`` on dispatch (:class:`ChaoticVerifier`/:class:`ChaoticBackend`);
-* pipeline dispatch callables (:func:`chaotic_dispatch`).
+* pipeline dispatch callables (:func:`chaotic_dispatch`);
+* chain-layer hooks — seeded kill -9 points for crash/restart suites
+  (:class:`CrashRestart` raising :class:`SimulatedCrash`), recovered via
+  ``ChainRunner.recover()`` WAL replay.
 
 Any chaos-test failure prints a ``CHAOS-REPLAY`` line with the seed and
 schedule digest (:func:`replay_on_failure`); ``scripts/chaos_replay.py``
@@ -32,11 +35,15 @@ from .wrappers import (
     ChaoticDeliver,
     ChaoticTransport,
     ChaoticVerifier,
+    CrashRestart,
+    SimulatedCrash,
     chaotic_dispatch,
     corrupt_message,
 )
 
 __all__ = [
+    "CrashRestart",
+    "SimulatedCrash",
     "FaultConfig",
     "FaultInjector",
     "InjectedDeviceError",

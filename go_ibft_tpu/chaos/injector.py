@@ -125,6 +125,12 @@ class FaultInjector:
             slow_s=c.slow_verify_s if u_slow < c.slow_verify_rate else 0.0,
         )
 
+    def crash_after(self, site: str, lo: int = 1, hi: int = 8) -> int:
+        """Seeded kill-point: the 1-based event count at which a
+        :class:`~go_ibft_tpu.chaos.wrappers.CrashRestart` armed at ``site``
+        fires.  One draw, so schedules stay byte-stable."""
+        return self._stream(site).randint(lo, hi)
+
     def device_error(self, site: str) -> "InjectedDeviceError":
         """The exception a chaotic dispatch raises — mimics an XLA
         ``RuntimeError`` surfacing from a dead device, and names the seed

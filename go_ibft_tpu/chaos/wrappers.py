@@ -180,6 +180,13 @@ class ChaoticVerifier:
         self._gate()
         return self.inner.verify_committed_seals(proposal_hash, seals, height)
 
+    def verify_seal_lanes(self, lanes, height):
+        # The cross-height sync drain passes the same device-fault gate as
+        # every other dispatch (without this explicit hop, __getattr__
+        # would forward it to the inner verifier chaos-free).
+        self._gate()
+        return self.inner.verify_seal_lanes(lanes, height)
+
     def certify_senders(self, msgs, height, threshold=None):
         self._gate()
         return self.inner.certify_senders(msgs, height, threshold)
@@ -230,6 +237,79 @@ class ChaoticBackend:
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
+
+
+class SimulatedCrash(BaseException):
+    """The kill -9 stand-in a :class:`CrashRestart` raises at its seeded
+    point.  A ``BaseException`` deliberately — a real kill is not
+    catchable by the engine's advisory ``except Exception`` guards, so
+    neither is its simulation; it unwinds straight to the test harness.
+    Carries the seed + site so a crash-test failure is replayable like
+    every other chaos artifact."""
+
+
+class CrashRestart:
+    """Seeded kill-point injection for crash/restart suites.
+
+    Arms a deterministic crash at the Nth hit of a named site (N drawn
+    once from the injector's per-site stream, so the schedule is
+    byte-stable per seed).  Typical wiring: wrap a chain-layer hook —
+    ``IBFT.on_lock`` (die mid-round holding a fresh PC) or ``on_finalize``
+    (die between the WAL append and the store prune) — with
+    :meth:`wrap`; when the crash fires the wrapper raises
+    :class:`SimulatedCrash` AFTER forwarding to the real hook (the process
+    died after the durable step, the kill -9 shape) or BEFORE it
+    (``before=True`` — died short of durability), and the harness treats
+    the node as dead: cancel its tasks, drop its in-memory state, rebuild
+    from the WAL via ``ChainRunner.recover()``.
+    """
+
+    def __init__(
+        self,
+        injector: FaultInjector,
+        site: str,
+        *,
+        lo: int = 1,
+        hi: int = 4,
+    ) -> None:
+        self._injector = injector
+        self.site = site
+        self.crash_at = injector.crash_after(site, lo, hi)
+        self.hits = 0
+        self.fired = False
+
+    def check(self) -> None:
+        """Count one event; raise :class:`SimulatedCrash` at the kill
+        point (exactly once — a restarted node re-arming the same object
+        keeps running)."""
+        self.hits += 1
+        if not self.fired and self.hits >= self.crash_at:
+            self.fired = True
+            _count("crashes", site=self.site)
+            raise SimulatedCrash(
+                f"chaos: injected crash at event {self.hits} "
+                f"(seed={self._injector.seed}, site={self.site})"
+            )
+
+    def wrap(self, hook: Optional[Callable], *, before: bool = False):
+        """Wrap a hook callable with this kill point.
+
+        ``before=False`` (default): the real hook runs first, THEN the
+        crash fires — the durable step completed, the process died on the
+        way out.  ``before=True``: the crash pre-empts the hook — death
+        short of durability.  A ``None`` hook is allowed (the crash point
+        alone is the wrapped behavior).
+        """
+
+        def wrapped(*args, **kwargs):
+            if before:
+                self.check()
+            result = hook(*args, **kwargs) if hook is not None else None
+            if not before:
+                self.check()
+            return result
+
+        return wrapped
 
 
 def chaotic_dispatch(

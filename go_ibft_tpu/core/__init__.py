@@ -14,7 +14,12 @@ from .backend import (
     ValidatorBackend,
     Verifier,
 )
-from .ibft import DEFAULT_BASE_ROUND_TIMEOUT, IBFT, get_round_timeout
+from .ibft import (
+    DEFAULT_BASE_ROUND_TIMEOUT,
+    IBFT,
+    RestoredState,
+    get_round_timeout,
+)
 from .state import SequenceState, StateName
 from .transport import BatchingIngress, LoopbackTransport, Transport
 from .validator_manager import (

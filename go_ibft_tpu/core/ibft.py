@@ -21,8 +21,10 @@ workers (the reference's WaitGroup barrier) before the next round starts.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
 
 from ..messages import helpers
 from ..messages.events import SubscriptionDetails
@@ -68,6 +70,20 @@ def get_round_timeout(
     return base_round_timeout * (_ROUND_FACTOR_BASE**exponent) + additional_timeout
 
 
+@dataclass
+class RestoredState:
+    """Crash-recovered in-flight state for one height (chain/wal.py lock
+    records).  ``run_sequence(height, restore=...)`` re-enters the height
+    at ``round`` with the prepared-certificate lock intact, so a restarted
+    validator that had already sent COMMIT for a proposal can never
+    prepare a different one for the same height (the equivocation the WAL
+    exists to prevent)."""
+
+    height: int
+    round: int
+    certificate: Optional[PreparedCertificate] = None
+
+
 class _NewProposalEvent:
     """A valid proposal for a higher round (reference core/ibft.go:195-198)."""
 
@@ -94,6 +110,12 @@ class _RoundSignals:
         )
         self.round_expired: asyncio.Future = asyncio.get_running_loop().create_future()
         self.round_done: asyncio.Future = asyncio.get_running_loop().create_future()
+        # Process-fatal BaseException surfaced by a worker (a simulated
+        # kill -9, KeyboardInterrupt, ...): unlike an ordinary worker crash
+        # (logged at teardown; the round retries via its timer) this must
+        # END the sequence — a worker replaced next round would let a
+        # "dead" node keep participating.
+        self.fatal: asyncio.Future = asyncio.get_running_loop().create_future()
 
     def all(self) -> list[asyncio.Future]:
         return [
@@ -101,6 +123,7 @@ class _RoundSignals:
             self.round_certificate,
             self.round_expired,
             self.round_done,
+            self.fatal,
         ]
 
     @staticmethod
@@ -163,6 +186,39 @@ class IBFT:
         # carried hash now costs one backend call per round.
         self._hash_memo: dict[bytes, bool] = {}
         self._hash_memo_cap = 1024
+        # Bounded future-height ingress buffer: messages for height H+1
+        # arriving while H is still finalizing are held here (dedup by
+        # (type, height, round, sender), the store's slot rule) instead of
+        # flowing straight into the main store — the pre-chain gate let ANY
+        # future height in, an unbounded spam surface.  Flushed through the
+        # verified ingress path by run_sequence(H+1), or pre-verified early
+        # by the chain runner's overlap worker (take_future_messages).
+        # Signatures are NOT verified at buffer time, so both a per-sender
+        # and a total cap bound what a sender-forging spammer can pin.
+        self._future_lock = threading.Lock()
+        self._future_buffer: dict[bytes, dict[tuple, IbftMessage]] = {}
+        self._future_count = 0
+        self.future_cap_per_sender = 16
+        self.future_cap_total = 4096
+        # PREPREPAREs get a longer buffer horizon than the flood-prone
+        # types: a proposal is one message per (height, proposer) by the
+        # dedup key, so holding a few heights' worth is still strictly
+        # bounded — and dropping one wedges a lagging node permanently
+        # (proposers never re-send; a node that missed the proposal for
+        # height H while catching up can neither run H nor, if it is H+1's
+        # proposer, let anyone else proceed).
+        self.future_proposal_horizon = 4
+        # Chain-layer hooks (go_ibft_tpu.chain): on_lock fires when a
+        # prepare quorum pins the PC (the WAL's in-flight lock record);
+        # on_finalize fires after insert_proposal and BEFORE the store
+        # prune — the crash-consistent finalize -> WAL append -> prune
+        # ordering the chain WAL relies on.
+        self.on_lock: Optional[
+            Callable[[int, int, PreparedCertificate, Optional[Proposal]], None]
+        ] = None
+        self.on_finalize: Optional[
+            Callable[[int, Proposal, list[CommittedSeal]], None]
+        ] = None
         # Flight-recorder track: one timeline row per node, so a 6-node
         # height renders as six labeled rows (obs/export.py).  Named after
         # the validator identity when the backend provides one.
@@ -185,11 +241,18 @@ class IBFT:
     # sequence driver (reference core/ibft.go:304-395)
     # ------------------------------------------------------------------
 
-    async def run_sequence(self, height: int) -> None:
+    async def run_sequence(
+        self, height: int, *, restore: Optional[RestoredState] = None
+    ) -> None:
         """Run the IBFT sequence for ``height`` until a proposal is finalized.
 
         Cancel the surrounding task to abort; the backend's
         ``sequence_cancelled`` callback fires and CancelledError propagates.
+
+        ``restore`` re-enters the height mid-round with a crash-recovered
+        prepared-certificate lock (chain/wal.py): the state machine resumes
+        in COMMIT for the restored round and re-announces its COMMIT for
+        the locked proposal instead of starting the height from scratch.
         """
         start_time = time.monotonic()
 
@@ -217,6 +280,12 @@ class IBFT:
             return
 
         self.messages.prune_by_height(height)
+        # Early traffic for THIS height that arrived while the previous
+        # height was finalizing: flush it through the verified ingress path
+        # (unless the chain runner's overlap worker already did).
+        self._flush_future(height)
+        if restore is not None and restore.height == height:
+            self._apply_restore(restore)
 
         self.log.info("sequence started", height)
         trace.instant("sequence.start", track=self._obs_track, height=height)
@@ -244,19 +313,27 @@ class IBFT:
                 self._signals = signals
                 workers = [
                     asyncio.create_task(
-                        self._start_round_timer(signals, current_round),
+                        self._guard_worker(
+                            self._start_round_timer(signals, current_round),
+                            signals,
+                        ),
                         name=f"ibft-timer-h{height}-r{current_round}",
                     ),
                     asyncio.create_task(
-                        self._watch_for_future_proposal(signals),
+                        self._guard_worker(
+                            self._watch_for_future_proposal(signals), signals
+                        ),
                         name=f"ibft-future-proposal-h{height}-r{current_round}",
                     ),
                     asyncio.create_task(
-                        self._watch_for_round_change_certificates(signals),
+                        self._guard_worker(
+                            self._watch_for_round_change_certificates(signals),
+                            signals,
+                        ),
                         name=f"ibft-rcc-watch-h{height}-r{current_round}",
                     ),
                     asyncio.create_task(
-                        self._start_round(signals),
+                        self._guard_worker(self._start_round(signals), signals),
                         name=f"ibft-round-h{height}-r{current_round}",
                     ),
                 ]
@@ -300,7 +377,14 @@ class IBFT:
                 # round timer), finishing beats a moot round change — the
                 # liveness-safe resolution of the tie the reference leaves to
                 # chance.
-                if signals.round_done.done():
+                if signals.fatal.done():
+                    # A worker hit a process-fatal BaseException (simulated
+                    # kill -9, KeyboardInterrupt): the sequence ENDS —
+                    # letting the round timer replace the dead worker would
+                    # keep a "dead" node participating in consensus.
+                    await teardown()
+                    raise signals.fatal.result()
+                elif signals.round_done.done():
                     # Consensus for this height is finished (ibft.go:376-382).
                     await teardown()
                     self._insert_block()
@@ -334,6 +418,26 @@ class IBFT:
             self.log.info("sequence done", height)
 
     # -- round workers ------------------------------------------------------
+
+    async def _guard_worker(self, coro, signals: _RoundSignals) -> None:
+        """Surface process-fatal worker deaths to the round arbitration.
+
+        Ordinary ``Exception`` crashes keep today's semantics (logged at
+        teardown; the round retries through its timer).  A non-Exception
+        ``BaseException`` — a simulated kill -9 from the chaos harness, a
+        KeyboardInterrupt — fires the ``fatal`` signal so ``run_sequence``
+        tears the round down immediately and re-raises it, instead of the
+        next round silently spawning a replacement worker."""
+        try:
+            await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            raise
+        except BaseException as err:
+            # The exception IS the signal value; run_sequence re-raises it.
+            signals.fire(signals.fatal, err)
+            raise
 
     async def _start_round_timer(self, signals: _RoundSignals, round_: int) -> None:
         """Exponential round timer worker (reference core/ibft.go:145-165)."""
@@ -419,7 +523,13 @@ class IBFT:
         validator_id = self.backend.id()
         view = self.state.view
 
-        if self.backend.is_proposer(validator_id, view.height, view.round):
+        if (
+            self.backend.is_proposer(validator_id, view.height, view.round)
+            and self.state.proposal_message is None
+        ):
+            # The proposal_message guard covers crash recovery: a restored
+            # lock re-enters the round with its proposal already accepted,
+            # and re-proposing over it would tear the lock down.
             self.log.info("we are the proposer")
 
             proposal_message = await self._build_proposal(view)
@@ -671,16 +781,34 @@ class IBFT:
         if not self._has_quorum_by_msg_type(prepare_messages, MessageType.PREPARE):
             return False
 
+        certificate = PreparedCertificate(
+            proposal_message=self.state.proposal_message,
+            prepare_messages=prepare_messages,
+        )
+        proposal = self.state.proposal
+        self.state.finalize_prepare(certificate, proposal)
+        if self.on_lock is not None:
+            # The WAL's in-flight lock record, made durable BEFORE the
+            # commit multicast below: once a COMMIT for this proposal can
+            # exist on the network, a crash-and-restart of this node must
+            # find the lock and can never prepare a different proposal for
+            # the height (the reference orders send-then-state in memory,
+            # ibft.go:855-889; with persistence in the loop the lock has
+            # to lead).  A FAILED append therefore withholds the COMMIT —
+            # the node stays locked in memory and still finalizes from its
+            # peers' commits, it just contributes no commit of its own
+            # this round (safety over one node's liveness share; sending
+            # anyway would re-open the equivocation window the ordering
+            # exists to close).
+            try:
+                self.on_lock(view.height, view.round, certificate, proposal)
+            except Exception as err:  # noqa: BLE001 - degrade, don't equivocate
+                self.log.error(
+                    "lock hook failed; commit withheld", view, err
+                )
+                return True
         self._send_commit_message(view)
         self.log.debug("commit message multicasted")
-
-        self.state.finalize_prepare(
-            PreparedCertificate(
-                proposal_message=self.state.proposal_message,
-                prepare_messages=prepare_messages,
-            ),
-            self.state.proposal,
-        )
         return True
 
     def _handle_commit(self, view: View) -> bool:
@@ -1029,6 +1157,7 @@ class IBFT:
         if message is None:
             return
         if not self._is_acceptable_message(message):
+            self._buffer_future(message)
             return
         self.messages.add_message(message)
         self._signal_if_quorum(message.view, message.type)
@@ -1046,7 +1175,12 @@ class IBFT:
         with trace.span(
             "ingress.batch", track=self._obs_track, lanes=len(batch)
         ):
-            gated = [m for m in batch if self._gate_height_round(m)]
+            gated = []
+            for m in batch:
+                if self._gate_height_round(m):
+                    gated.append(m)
+                else:
+                    self._buffer_future(m)
             if self.batch_verifier is not None:
                 mask = self.batch_verifier.verify_senders(gated)
                 accepted = [m for m, ok in zip(gated, mask) if bool(ok)]
@@ -1065,6 +1199,182 @@ class IBFT:
                 to_signal.setdefault(key, (message.view, message.type))
         for view, message_type in to_signal.values():
             self._signal_if_quorum(view, message_type)
+
+    def add_verified_messages(self, batch: Sequence[IbftMessage]) -> None:
+        """Store messages whose envelope signatures the caller has ALREADY
+        verified through this engine's own verifier.
+
+        The chain runner's cross-height overlap worker uses this: it
+        drains the future-height buffer and batch-verifies the envelopes
+        while the previous height's COMMIT drain is still in flight, then
+        hands the survivors over here — no re-verification, no height gate
+        (the messages are for a height the engine has not reached yet; the
+        store keys them by their own view and ``run_sequence`` finds them
+        via subscribe-then-recheck).  NEVER feed this from an unverified
+        source: the store's last-write-wins dedup would let a forged
+        sender evict a genuine message.
+        """
+        to_signal: dict[tuple[int, int, int], tuple[View, object]] = {}
+        for message in batch:
+            if message.view is None or not isinstance(message.type, MessageType):
+                continue
+            self.messages.add_message(message)
+            key = (message.view.height, message.view.round, int(message.type))
+            to_signal.setdefault(key, (message.view, message.type))
+        for view, message_type in to_signal.values():
+            self._signal_if_quorum(view, message_type)
+
+    # -- future-height buffer (chain handoff support) -----------------------
+
+    def _buffer_future(self, message: Optional[IbftMessage]) -> bool:
+        """Hold a message ONE height ahead (bounded, deduped).
+
+        Anything further ahead is dropped: consensus only ever needs the
+        NEXT height's early traffic, and an unbounded horizon is an
+        unbounded spam surface.  Dedup key (type, height, round, sender)
+        matches the store's slot rule with last-write-wins.  PREPREPAREs
+        alone get ``future_proposal_horizon`` heights (see __init__: one
+        proposal per height per sender, and a dropped one is a liveness
+        wedge)."""
+        if message is None or message.view is None:
+            return False
+        if not isinstance(message.type, MessageType):
+            return False
+        view = message.view
+        horizon = (
+            self.future_proposal_horizon
+            if message.type == MessageType.PREPREPARE
+            else 1
+        )
+        if not self.state.height < view.height <= self.state.height + horizon:
+            return False
+        # Membership pre-filter on the CLAIMED sender (no signature work):
+        # without it, forged identities fill future_cap_total for free and
+        # starve genuine validators' early traffic every height.  A
+        # non-member claim can never verify at flush anyway; when the
+        # embedder cannot answer for a future height, fall through — the
+        # caps still bound the buffer.
+        try:
+            if message.sender not in self.backend.get_voting_powers(
+                view.height
+            ):
+                return False
+        except Exception:  # noqa: BLE001 - unknown future set: caps bound it
+            pass
+        key = (int(message.type), view.height, view.round, message.sender)
+        with self._future_lock:
+            per_sender = self._future_buffer.setdefault(message.sender, {})
+            slot = per_sender.get(key)
+            if slot is not None:
+                # Each slot keeps the FIRST and the LATEST candidate.  The
+                # buffer holds UNVERIFIED messages, so plain last-write-
+                # wins would let a forged-sender message evict a genuine
+                # buffered one (and first-write-wins would let a forgery
+                # that raced ahead pin the slot).  With both ends kept,
+                # the genuine message survives either arrival order; the
+                # flush verifies all candidates and the store's own
+                # (verified) last-write-wins dedup settles the slot.
+                if len(slot) == 1:
+                    slot.append(message)
+                    self._future_count += 1
+                else:
+                    slot[1] = message
+                return True
+            if (
+                len(per_sender) >= self.future_cap_per_sender
+                or self._future_count >= self.future_cap_total
+            ):
+                if not per_sender:
+                    del self._future_buffer[message.sender]
+                return False
+            per_sender[key] = [message]
+            self._future_count += 1
+        return True
+
+    def take_future_messages(self, height: int) -> list[IbftMessage]:
+        """Pop every buffered message for ``height``; drop anything staler.
+
+        Called by ``run_sequence(height)`` at height start (the default
+        flush) and by the chain runner's overlap worker, which pre-verifies
+        the batch off the critical path and re-inserts the survivors via
+        :meth:`add_verified_messages`."""
+        out: list[IbftMessage] = []
+        with self._future_lock:
+            for sender in list(self._future_buffer):
+                per_sender = self._future_buffer[sender]
+                for key in list(per_sender):
+                    if key[1] <= height:
+                        slot = per_sender.pop(key)
+                        self._future_count -= len(slot)
+                        if key[1] == height:
+                            out.extend(slot)
+                if not per_sender:
+                    del self._future_buffer[sender]
+        return out
+
+    @property
+    def future_buffered(self) -> int:
+        with self._future_lock:
+            return self._future_count
+
+    def future_commit_evidence(self, height: int) -> int:
+        """Combined voting power of the distinct senders with buffered
+        COMMITs for ``height`` — in the same units as
+        ``validator_manager.quorum_size``, so weighted validator sets
+        compare correctly (a raw sender count never reaches a
+        power-denominated quorum).
+
+        The chain layer's fall-behind tripwire: a quorum's worth of
+        COMMITs for a FUTURE height means peers are finalizing past this
+        node — consensus here cannot catch up, only block sync can.  The
+        senders are not signature-verified yet (the buffer holds raw
+        ingress; unknown senders weigh zero), so callers treat the value
+        as a hint: the sync path re-verifies every fetched block against
+        real quorums, making a spoofed trigger a wasted poll, never a
+        wrong chain."""
+        commit = int(MessageType.COMMIT)
+        with self._future_lock:
+            senders = [
+                sender
+                for sender, per_sender in self._future_buffer.items()
+                if any(
+                    key[0] == commit and key[1] == height
+                    for key in per_sender
+                )
+            ]
+        return sum(self.validator_manager.power_of(s) for s in senders)
+
+    def _flush_future(self, height: int) -> None:
+        batch = self.take_future_messages(height)
+        if batch:
+            self.add_messages(batch)
+
+    def _apply_restore(self, restore: RestoredState) -> None:
+        """Re-enter a height mid-round from a WAL lock record.
+
+        The restored engine resumes in COMMIT for the locked round with
+        the PC pinned (``latest_pc``), so its ROUND_CHANGE messages carry
+        the certificate and it can never prepare a different proposal for
+        this height — the no-equivocation recovery invariant.  It also
+        re-announces its COMMIT: the seal is rebuilt from the same key
+        over the same proposal hash, which peers dedup by sender."""
+        certificate = restore.certificate
+        self.state.set_view(View(height=restore.height, round=restore.round))
+        if hasattr(self.batch_verifier, "note_round"):
+            self.batch_verifier.note_round(restore.round)
+        if certificate is not None and certificate.proposal_message is not None:
+            proposal = helpers.extract_proposal(certificate.proposal_message)
+            self.state.set_proposal_message(certificate.proposal_message)
+            self.state.finalize_prepare(certificate, proposal)
+            self.state.set_round_started(True)
+            self._send_commit_message(self.state.view)
+        trace.instant(
+            "sequence.restore",
+            track=self._obs_track,
+            height=restore.height,
+            round=restore.round,
+            locked=certificate is not None,
+        )
 
     def _signal_if_quorum(self, view: Optional[View], message_type) -> None:
         """Signal subscribers when quorum became possible
@@ -1103,7 +1413,12 @@ class IBFT:
             return False
         if state_height == message.view.height:
             return message.view.round >= self.state.round
-        return True
+        # Future heights never enter the store through the gate: height+1
+        # goes through the bounded dedup buffer (the ingress paths call
+        # _buffer_future on gate failure), anything further is dropped —
+        # the old "accept any future height" rule let one spammer grow the
+        # store without bound.
+        return False
 
     # -- quorum dispatch (reference core/ibft.go:1272-1284) -----------------
 
@@ -1156,15 +1471,26 @@ class IBFT:
         self.state.change_state(StateName.PREPARE)
 
     def _insert_block(self) -> None:
-        """Insert the finalized block and GC (reference core/ibft.go:978-991)."""
-        self.backend.insert_proposal(
-            Proposal(
-                raw_proposal=self.state.raw_proposal or b"",
-                round=self.state.round,
-            ),
-            self.state.committed_seals,
+        """Insert the finalized block and GC (reference core/ibft.go:978-991).
+
+        The step order is the chain layer's crash-consistency contract:
+        finalize (insert_proposal) -> on_finalize (the WAL's fsynced
+        append) -> prune.  A crash between any two steps never loses a
+        finalized height — before the WAL append the store still holds the
+        commit-quorum evidence (nothing pruned yet), after it the height
+        is durable.  on_finalize is deliberately NOT exception-guarded: a
+        WAL that cannot append must stop the height from pruning the only
+        other copy of its evidence (chaos kill-point test pins this)."""
+        height = self.state.height
+        proposal = Proposal(
+            raw_proposal=self.state.raw_proposal or b"",
+            round=self.state.round,
         )
-        self.messages.prune_by_height(self.state.height)
+        seals = self.state.committed_seals
+        self.backend.insert_proposal(proposal, seals)
+        if self.on_finalize is not None:
+            self.on_finalize(height, proposal, seals)
+        self.messages.prune_by_height(height)
 
     # -- outbound (reference core/ibft.go:1234-1270) ------------------------
 

@@ -32,9 +32,29 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # moved to jax.shard_map in newer releases
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+import inspect as _inspect
+
+# The replication-check kwarg was renamed check_rep -> check_vma across
+# jax releases; resolve whichever spelling this jax understands so the
+# mesh programs build on both (the pinned CI jax still says check_rep).
+_CHECK_KW = next(
+    (
+        kw
+        for kw in ("check_vma", "check_rep")
+        if kw in _inspect.signature(_shard_map).parameters
+    ),
+    None,
+)
+
+
+def shard_map(*args, check_vma=False, **kwargs):
+    if _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(*args, **kwargs)
 
 from ..ops import quorum
 

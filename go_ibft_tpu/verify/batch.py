@@ -199,6 +199,51 @@ class HostBatchVerifier:
                     )
         return out
 
+    def verify_seal_lanes(
+        self, lanes: Sequence[Tuple[bytes, CommittedSeal]], height: int
+    ) -> np.ndarray:
+        """Per-lane-hash seal verification (the block-sync drain shape).
+
+        Each lane is ``(proposal_hash, seal)`` — sequential per-lane
+        recovers against that lane's own hash, membership against
+        ``height``'s validator set.  This is the oracle the batched sync
+        drain (DeviceBatchVerifier.verify_seal_lanes) is pinned to; the
+        caller groups heights so that every lane's own validator set
+        equals ``height``'s (chain/sync.py does this by snapshot).
+        """
+        out = np.zeros(len(lanes), dtype=bool)
+        with trace.span(
+            "verify.drain", kind="seal_lanes", route="host", lanes=len(lanes)
+        ):
+            with trace.span("verify.pack", lanes=len(lanes)):
+                prepared = []
+                for i, (proposal_hash, seal) in enumerate(lanes):
+                    if (
+                        len(proposal_hash) != 32
+                        or len(seal.signer) != ADDRESS_BYTES
+                        or len(seal.signature) != SIG_BYTES
+                    ):
+                        continue
+                    prepared.append(
+                        (i, proposal_hash, seal, *split_signature(seal.signature))
+                    )
+            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
+                recovered = [
+                    (i, seal, self._recover(proposal_hash, r, s, v))
+                    for i, proposal_hash, seal, r, s, v in prepared
+                ]
+            with trace.span("verify.device_wait", route="host"):
+                pass  # nothing in flight on the synchronous route
+            with trace.span("verify.quorum", lanes=len(recovered)):
+                for i, seal, pub in recovered:
+                    if pub is None:
+                        continue
+                    out[i] = (
+                        host_ecdsa.pubkey_to_address(*pub) == seal.signer
+                        and self._is_member(height, seal.signer)
+                    )
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Device kernels (shape-polymorphic via jit retrace per bucket triple)
@@ -479,6 +524,63 @@ def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_la
         s_limbs[:n] = _words_to_limbs(sw, nl)
         v[:n] = vv
         signers[:n] = dk.addresses_to_words([s.signer for s in seals])
+        live[:n] = True
+    return hash_zw, r_limbs, s_limbs, v, signers, live
+
+
+def validate_seal_lanes(lanes: Sequence[Tuple[bytes, CommittedSeal]]) -> None:
+    """Shape-validate (proposal_hash, seal) lanes, naming the bad lane.
+
+    The ONE definition of what a well-formed sync lane is — shared by the
+    per-lane packer and the resilient fallback rung so their
+    :class:`MalformedLaneError` quarantine semantics can never drift."""
+    for i, (proposal_hash, seal) in enumerate(lanes):
+        if len(proposal_hash) != 32:
+            raise MalformedLaneError(i, "proposal_hash", 32, len(proposal_hash))
+        if len(seal.signature) != SIG_BYTES:
+            raise MalformedLaneError(i, "signature", SIG_BYTES, len(seal.signature))
+        if len(seal.signer) != ADDRESS_BYTES:
+            raise MalformedLaneError(i, "signer", ADDRESS_BYTES, len(seal.signer))
+
+
+def pack_seal_lanes(
+    lanes: Sequence[Tuple[bytes, CommittedSeal]], pad_lanes: int = 0
+):
+    """(proposal_hash, seal) lanes -> device arrays with PER-LANE hashes.
+
+    The block-sync drain verifies committed seals across a whole height
+    RANGE at once — every height signs its own proposal hash, so unlike
+    :func:`pack_seal_batch` (one hash broadcast to all lanes) each lane
+    here carries its own 32-byte hash.  The device kernel already takes
+    per-lane hash words (``hash_zw`` rows); only the packers assumed one
+    hash per drain.  Returns the same ``(hash_words, r, s, v, signers,
+    live)`` tuple; lengths are validated up front with
+    :class:`MalformedLaneError` naming the lane (a bad per-lane hash IS a
+    lane fault here, not a batch-wide error).
+    """
+    validate_seal_lanes(lanes)
+    n = len(lanes)
+    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    nl = sec.FIELD.nlimbs
+    hash_zw = np.zeros((bb, 8), dtype=np.uint32)
+    r_limbs = np.zeros((bb, nl), dtype=np.int32)
+    s_limbs = np.zeros((bb, nl), dtype=np.int32)
+    v = np.zeros((bb,), dtype=np.int32)
+    signers = np.zeros((bb, 5), dtype=np.uint32)
+    live = np.zeros((bb,), dtype=bool)
+    if n:
+        # Same word layout as pack_seal_batch: 8 big-endian u32 words per
+        # hash, reversed to little-endian value order — vectorized over
+        # all lanes in one frombuffer.
+        hw = np.frombuffer(
+            b"".join(h for h, _ in lanes), ">u4"
+        ).reshape(n, 8)[:, ::-1]
+        hash_zw[:n] = hw.astype(np.uint32)
+        rw, sw, vv = _split_signatures([s.signature for _, s in lanes])
+        r_limbs[:n] = _words_to_limbs(rw, nl)
+        s_limbs[:n] = _words_to_limbs(sw, nl)
+        v[:n] = vv
+        signers[:n] = dk.addresses_to_words([s.signer for _, s in lanes])
         live[:n] = True
     return hash_zw, r_limbs, s_limbs, v, signers, live
 
@@ -1126,6 +1228,52 @@ class DeviceBatchVerifier:
                     out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
+    def verify_seal_lanes(
+        self, lanes: Sequence[Tuple[bytes, CommittedSeal]], height: int
+    ) -> np.ndarray:
+        """Cross-height batched seal drain: per-lane proposal hashes.
+
+        The block-sync catch-up path verifies EVERY committed seal of a
+        fetched height range in one drain — each height signs its own
+        proposal hash, so lanes carry their own hash words
+        (:func:`pack_seal_lanes`); the recovery ladder and membership
+        check are the same program as the single-hash drain.  All lanes
+        are checked against ``height``'s validator table (callers group
+        ranges by validator-set snapshot).  Chunks above the largest lane
+        bucket ride the double-buffered pipeline like every other flood.
+        """
+        out = np.zeros(len(lanes), dtype=bool)
+        idxs = [
+            i
+            for i, (proposal_hash, seal) in enumerate(lanes)
+            if len(proposal_hash) == 32 and self._well_formed_seal(seal)
+        ]
+        if not idxs:
+            return out
+        items = [
+            idxs[start : start + _BATCH_BUCKETS[-1]]
+            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+        ]
+
+        def pack(chunk):
+            with trace.span("verify.pack", kind="seal_lanes", lanes=len(chunk)):
+                inputs = pack_seal_lanes([lanes[i] for i in chunk])
+            return chunk, inputs, self._table_dev(height)
+
+        with trace.span(
+            "verify.drain",
+            route="device",
+            kind="seal_lanes",
+            chunks=len(items),
+        ):
+            results = self._run_chunk_pipeline(
+                items, pack, "verify_seal_lanes_ms"
+            )
+            with trace.span("verify.quorum", route="mask"):
+                for chunk, mask in results:
+                    out[np.asarray(chunk)] = mask[: len(chunk)]
+        return out
+
     def verify_round_chunked(
         self,
         msgs: Sequence[IbftMessage],
@@ -1281,6 +1429,43 @@ class ResilientBatchVerifier:
                 proposal_hash, [seals[i] for i in idxs], height
             ),
         )
+
+    def verify_seal_lanes(
+        self, lanes: Sequence[Tuple[bytes, CommittedSeal]], height: int
+    ) -> np.ndarray:
+        """Cross-height sync drain through the degradation ladder: poison
+        lanes quarantine by bisection, a faulting device demotes to the
+        host rungs — the block-sync catch-up path's fallback route."""
+        lanes = list(lanes)
+        return self._drain(
+            lanes,
+            lambda rung, idxs: self._run_seal_lanes(
+                rung, [lanes[i] for i in idxs], height
+            ),
+        )
+
+    @staticmethod
+    def _run_seal_lanes(rung, lanes, height) -> np.ndarray:
+        if hasattr(rung, "verify_seal_lanes"):
+            return rung.verify_seal_lanes(lanes, height)
+        # Rung without the per-lane-hash entry point (a bare BatchVerifier
+        # protocol implementer): validate lane shapes FIRST so malformed
+        # lanes raise with the drain-relative index the bisection expects,
+        # then group by hash and reuse the single-hash drain per group.
+        validate_seal_lanes(lanes)
+        out = np.zeros(len(lanes), dtype=bool)
+        groups: Dict[bytes, List[int]] = {}
+        for i, (proposal_hash, _seal) in enumerate(lanes):
+            groups.setdefault(proposal_hash, []).append(i)
+        for proposal_hash, idxs in groups.items():
+            mask = np.asarray(
+                rung.verify_committed_seals(
+                    proposal_hash, [lanes[i][1] for i in idxs], height
+                ),
+                dtype=bool,
+            )
+            out[np.asarray(idxs)] = mask[: len(idxs)]
+        return out
 
     # -- drain machinery -------------------------------------------------
 
@@ -1449,6 +1634,16 @@ class AdaptiveBatchVerifier:
         if self._host_sized(len(seals)):
             return self.host.verify_committed_seals(proposal_hash, seals, height)
         return self._resilient.verify_committed_seals(proposal_hash, seals, height)
+
+    def verify_seal_lanes(
+        self, lanes: Sequence[Tuple[bytes, CommittedSeal]], height: int
+    ) -> np.ndarray:
+        """Cross-height sync drain, routed like any other seal drain: tiny
+        ranges on the sequential host path, everything else through the
+        device ladder (the block-sync catch-up's normal route)."""
+        if self._host_sized(len(lanes)):
+            return self.host.verify_seal_lanes(lanes, height)
+        return self._resilient.verify_seal_lanes(lanes, height)
 
     # -- FusedBatchVerifier ---------------------------------------------
 

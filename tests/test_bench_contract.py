@@ -65,6 +65,7 @@ _FIVE_CONFIG_KEYS = (
     "bls_aggregate_verify_p50_100v",
     "byzantine_300v_30pct_prepare_commit_p50",
     "chaos_degraded_overhead_100v",
+    "chain_sustained_20h_100v",
     bench.headline_metric(True),
 )
 
@@ -157,6 +158,27 @@ def test_driver_conditions_config3_pipelined_packing_evidence(driver_run):
         assert line["pipeline_speedup"] >= 0.9, line
     if line.get("native_verify"):
         assert line["pack_lanes_per_s"] >= 25_000, line
+
+
+def test_driver_conditions_config7_chain_evidence(driver_run):
+    """Config #7's evidence schema (ISSUE 5): a MEASURED blocks/s line
+    from a 20-height (6 without the native signer) 4-node ChainRunner
+    cluster, carrying BOTH overlap variants and the per-height handoff
+    attribution.  Handoff must stay well under a millisecond — the whole
+    point of removing the per-height spawn/teardown barrier — and the
+    chain must actually have sustained every height (the variants embed
+    elapsed_s, so a null or partial run cannot masquerade)."""
+    _, by_metric, _ = driver_run
+    line = by_metric["chain_sustained_20h_100v"]
+    assert line["unit"] == "blocks/s"
+    assert line["value"] > 0
+    for variant in ("overlap_on", "overlap_off"):
+        sub = line[variant]
+        assert sub["blocks_per_s"] > 0, line
+        assert sub["handoff_ms_mean"] < 1.0, line
+        assert "overlapped_lanes" in sub and "synced_heights" in sub, line
+    assert line["heights"] in (6, 20)
+    assert line["vs_baseline"] is not None
 
 
 def test_driver_conditions_happy_path_parity(driver_run):

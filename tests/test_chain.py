@@ -1,0 +1,600 @@
+"""Chain layer: WAL durability, future-height buffer, runner lifecycle.
+
+Pins the ISSUE 5 tentpole invariants:
+
+* WAL round-trip + torn-tail tolerance + interior-corruption refusal;
+* the bounded future-height ingress buffer (a PREPARE sent during height
+  H's commit phase is NOT lost for H+1 — the satellite regression);
+* crash-consistent finalize -> WAL append -> prune ordering (seeded
+  kill-points on either side of the append never lose a finalized
+  height);
+* ChainRunner: back-to-back heights with no inter-height barrier,
+  per-height ``chain.height``/``chain.handoff`` spans, and the
+  cross-height overlap worker pre-verifying buffered H+1 ingress.
+"""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.chain import (
+    ChainRunner,
+    WalCorruptionError,
+    WriteAheadLog,
+)
+from go_ibft_tpu.chaos import CrashRestart, FaultInjector, SimulatedCrash
+from go_ibft_tpu.core import IBFT, StateName
+from go_ibft_tpu.core.ibft import RestoredState
+from go_ibft_tpu.messages import MessageType, View
+from go_ibft_tpu.messages.wire import PreparedCertificate, Proposal
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.obs import trace
+from go_ibft_tpu.utils import metrics
+
+from harness import (
+    MockBackend,
+    NullLogger,
+    VALID_BLOCK,
+    VALID_PROPOSAL_HASH,
+    build_commit,
+    build_preprepare,
+    build_prepare,
+)
+
+NODES = [b"node-%d" % i for i in range(4)]
+
+
+def make_engine(our_id=b"node-3", proposer=b"node-0"):
+    """Standalone engine: node-0 proposes, we are node-3 (not proposer)."""
+    backend = MockBackend(our_id)
+    backend.voting_powers = {n: 1 for n in NODES}
+    backend.is_proposer_fn = lambda vid, h, r: vid == proposer
+    engine = IBFT(NullLogger(), backend, _RecordingTransport())
+    engine.set_base_round_timeout(5.0)
+    return engine, backend
+
+
+class _RecordingTransport:
+    def __init__(self):
+        self.sent = []
+
+    def multicast(self, message):
+        self.sent.append(message)
+
+
+def full_height_messages(height, round_=0):
+    """A finalizable message set for one height: proposal from node-0,
+    PREPAREs from non-proposers (a proposer PREPARE voids the quorum),
+    COMMITs from a quorum."""
+    view = View(height=height, round=round_)
+    msgs = [build_preprepare(VALID_BLOCK, VALID_PROPOSAL_HASH, None, view, b"node-0")]
+    for sender in NODES[1:3]:
+        msgs.append(build_prepare(VALID_PROPOSAL_HASH, view, sender))
+    for sender in NODES[:3]:
+        msgs.append(build_commit(VALID_PROPOSAL_HASH, view, sender))
+    return msgs
+
+
+# -- WAL ---------------------------------------------------------------------
+
+
+def _seals(n=3):
+    return [CommittedSeal(signer=NODES[i], signature=b"\x05" * 65) for i in range(n)]
+
+
+def test_wal_round_trip(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    for h in (1, 2, 3):
+        wal.append_finalize(h, Proposal(raw_proposal=b"block %d" % h, round=0), _seals())
+    wal.close()
+    state = WriteAheadLog(wal.path).replay()
+    assert [b.height for b in state.blocks] == [1, 2, 3]
+    assert state.blocks[1].proposal.raw_proposal == b"block 2"
+    assert [s.signer for s in state.blocks[0].seals] == [n for n in NODES[:3]]
+    assert state.next_height == 4
+    assert state.lock is None
+    assert not state.dropped_tail
+
+
+def test_wal_lock_survives_only_while_unfinalized(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    pc = PreparedCertificate(
+        proposal_message=build_preprepare(
+            VALID_BLOCK, VALID_PROPOSAL_HASH, None, View(height=1, round=2), b"node-0"
+        ),
+        prepare_messages=[
+            build_prepare(VALID_PROPOSAL_HASH, View(height=1, round=2), n)
+            for n in NODES[:3]
+        ],
+    )
+    wal.append_lock(1, 2, pc)
+    state = WriteAheadLog(wal.path).replay()
+    assert state.lock is not None and (state.lock.height, state.lock.round) == (1, 2)
+    # the certificate round-trips bit-identically through the wire codec
+    assert state.lock.certificate.encode() == pc.encode()
+    # finalizing the height supersedes the lock
+    wal.append_finalize(1, Proposal(raw_proposal=VALID_BLOCK, round=2), _seals())
+    state = WriteAheadLog(wal.path).replay()
+    assert state.lock is None and state.next_height == 2
+
+
+def test_wal_torn_tail_tolerated(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    wal.append_finalize(1, Proposal(raw_proposal=b"b1", round=0), [])
+    wal.append_finalize(2, Proposal(raw_proposal=b"b2", round=0), [])
+    wal.close()
+    with open(wal.path, "ab") as fh:  # crash mid-append: partial last line
+        fh.write(b'{"kind":"finalize","height":3,"proposal":"6')
+    state = WriteAheadLog(wal.path).replay()
+    assert [b.height for b in state.blocks] == [1, 2]
+    assert state.dropped_tail
+
+
+def test_wal_torn_tail_truncated_so_next_append_is_clean(tmp_path):
+    """A dropped torn tail must also be TRUNCATED: otherwise the next
+    append merges with the partial bytes into one unparseable line, and a
+    later replay either loses a durably-fsynced record or refuses the
+    whole log as interior-corrupt."""
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    wal.append_finalize(1, Proposal(raw_proposal=b"b1", round=0), [])
+    wal.close()
+    with open(wal.path, "ab") as fh:
+        fh.write(b'{"kind":"finalize","height":2,"pro')  # torn append
+    recovered = WriteAheadLog(wal.path)
+    state = recovered.replay()
+    assert state.dropped_tail and [b.height for b in state.blocks] == [1]
+    # the node keeps running: the post-recovery append lands on its own line
+    recovered.append_finalize(2, Proposal(raw_proposal=b"b2", round=0), [])
+    state = WriteAheadLog(wal.path).replay()
+    assert [b.height for b in state.blocks] == [1, 2]
+    assert not state.dropped_tail
+
+
+def test_wal_append_without_replay_sanitizes_torn_tail(tmp_path):
+    """Nothing forces an embedder to replay() before appending: the first
+    append after a crash must itself cut the torn tail, or the new record
+    merges into one unparseable interior line and poisons the log."""
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    wal.append_finalize(1, Proposal(raw_proposal=b"b1", round=0), [])
+    wal.close()
+    with open(wal.path, "ab") as fh:
+        fh.write(b'{"kind":"finalize","height":2,"pro')
+    fresh = WriteAheadLog(wal.path)
+    fresh.append_finalize(3, Proposal(raw_proposal=b"b3", round=0), [])
+    state = WriteAheadLog(wal.path).replay()
+    assert [b.height for b in state.blocks] == [1, 3]
+    assert not state.dropped_tail
+
+
+def test_wal_interior_corruption_refused(tmp_path):
+    path = tmp_path / "wal.jsonl"
+    wal = WriteAheadLog(str(path))
+    wal.append_finalize(1, Proposal(raw_proposal=b"b1", round=0), [])
+    wal.append_finalize(2, Proposal(raw_proposal=b"b2", round=0), [])
+    wal.close()
+    lines = path.read_bytes().splitlines(keepends=True)
+    path.write_bytes(b"garbage not json\n" + lines[1])
+    with pytest.raises(WalCorruptionError):
+        WriteAheadLog(str(path)).replay()
+
+
+def test_wal_duplicate_finalize_keeps_first(tmp_path):
+    # A crash between the WAL append and the prune can re-deliver the same
+    # height (e.g. via block sync after recovery): the first, durable,
+    # record wins.
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    wal.append_finalize(1, Proposal(raw_proposal=b"first", round=0), [])
+    wal.append_finalize(1, Proposal(raw_proposal=b"second", round=1), [])
+    state = WriteAheadLog(wal.path).replay()
+    assert len(state.blocks) == 1
+    assert state.blocks[0].proposal.raw_proposal == b"first"
+
+
+# -- future-height buffer (satellite regression) -----------------------------
+
+
+async def test_prepare_during_commit_phase_not_lost_for_next_height():
+    """THE satellite regression: traffic for height H+1 arriving while H
+    is still in its commit phase must be available to H+1's sequence.
+
+    The engine sits at height 1 (mid-commit by construction); the whole
+    finalizable message set for height 2 arrives early.  It lands in the
+    bounded future buffer (never the store), and run_sequence(2) finalizes
+    from the flushed buffer alone — no redelivery."""
+    engine, backend = make_engine()
+    engine.state.reset(1)
+    engine.state.change_state(StateName.COMMIT)
+    early = full_height_messages(2)
+    for message in early:
+        engine.add_message(message)
+    # buffered, NOT stored (the store would be unbounded spam surface)
+    assert engine.future_buffered == len(early)
+    for message in early:
+        assert (
+            engine.messages.num_messages(message.view, message.type) == 0
+        )
+    await asyncio.wait_for(engine.run_sequence(2), 5)
+    assert engine.future_buffered == 0
+    assert [p.raw_proposal for p, _ in backend.inserted] == [VALID_BLOCK]
+    engine.messages.close()
+
+
+def test_future_buffer_rejects_beyond_one_height():
+    engine, _ = make_engine()
+    engine.state.reset(1)
+    far = build_prepare(VALID_PROPOSAL_HASH, View(height=3, round=0), b"node-1")
+    engine.add_message(far)
+    assert engine.future_buffered == 0
+
+
+def test_future_buffer_proposal_horizon():
+    """PREPREPAREs buffer several heights ahead (one per height per
+    proposer — strictly bounded, and a dropped proposal is a liveness
+    wedge for a lagging node); everything else stays at one height."""
+    engine, _ = make_engine()
+    engine.state.reset(1)
+    for h in (2, 3, 4, 5):
+        engine.add_message(
+            build_preprepare(
+                VALID_BLOCK, VALID_PROPOSAL_HASH, None, View(height=h, round=0), b"node-0"
+            )
+        )
+    assert engine.future_buffered == 4
+    # past the proposal horizon: dropped
+    engine.add_message(
+        build_preprepare(
+            VALID_BLOCK, VALID_PROPOSAL_HASH, None, View(height=9, round=0), b"node-0"
+        )
+    )
+    assert engine.future_buffered == 4
+    # taking height 2 keeps the still-future proposals for 3..5
+    assert len(engine.take_future_messages(2)) == 1
+    assert engine.future_buffered == 3
+
+
+def test_future_commit_evidence_sums_voting_power():
+    engine, backend = make_engine()
+    backend.voting_powers = {NODES[0]: 10, NODES[1]: 3, NODES[2]: 1, NODES[3]: 1}
+    engine.state.reset(1)
+    engine.validator_manager.init(1)
+    for sender in (b"node-0", b"node-1", b"node-0", b"stranger"):
+        engine.add_message(
+            build_commit(VALID_PROPOSAL_HASH, View(height=2, round=0), sender)
+        )
+    engine.add_message(
+        build_prepare(VALID_PROPOSAL_HASH, View(height=2, round=0), b"node-2")
+    )
+    # distinct COMMIT senders weighted by power (same units as
+    # quorum_size; unknown senders weigh zero; PREPAREs don't count)
+    assert engine.future_commit_evidence(2) == 13
+    assert engine.future_commit_evidence(3) == 0
+
+
+def test_future_buffer_bounded_and_deduped():
+    engine, _ = make_engine()
+    engine.state.reset(1)
+    # dedup: a slot keeps at most FIRST + LATEST candidate, never grows
+    for _ in range(5):
+        engine.add_message(
+            build_prepare(VALID_PROPOSAL_HASH, View(height=2, round=0), b"node-1")
+        )
+    assert engine.future_buffered == 2
+    # per-sender cap: one Byzantine VALIDATOR minting rounds cannot grow
+    # past the slot cap (each slot holds <= 2 candidates)
+    for round_ in range(100):
+        engine.add_message(
+            build_prepare(
+                VALID_PROPOSAL_HASH, View(height=2, round=round_), b"node-2"
+            )
+        )
+    assert engine.future_buffered <= 2 * (1 + engine.future_cap_per_sender)
+    # forged (non-member) senders never enter the buffer at all — the
+    # membership pre-filter keeps total capacity for genuine validators
+    before = engine.future_buffered
+    for i in range(200):
+        engine.add_message(
+            build_prepare(
+                VALID_PROPOSAL_HASH, View(height=2, round=0), b"spam-%d" % i
+            )
+        )
+    assert engine.future_buffered == before
+
+
+def test_future_buffer_forged_sender_cannot_evict_genuine():
+    """The buffer holds UNVERIFIED messages: a spoofed message for the
+    same (type, height, round, sender) slot must not evict a genuine one
+    in EITHER arrival order — both candidates survive to the verified
+    flush, where the store's post-verification dedup settles the slot."""
+    for genuine_first in (True, False):
+        engine, _ = make_engine()
+        engine.state.reset(1)
+        view = View(height=2, round=0)
+        genuine = build_prepare(VALID_PROPOSAL_HASH, view, b"node-1")
+        forged = build_prepare(b"forged-hash-000000", view, b"node-1")
+        first, second = (
+            (genuine, forged) if genuine_first else (forged, genuine)
+        )
+        engine.add_message(first)
+        for _ in range(3):  # a flood of spoofs rotates only the LAST slot
+            engine.add_message(forged)
+        engine.add_message(second)
+        taken = engine.take_future_messages(2)
+        assert genuine in taken, f"genuine evicted (genuine_first={genuine_first})"
+        engine.messages.close()
+
+
+def test_take_future_messages_drops_stale():
+    engine, _ = make_engine()
+    engine.state.reset(1)
+    engine.add_message(
+        build_prepare(VALID_PROPOSAL_HASH, View(height=2, round=0), b"node-1")
+    )
+    assert engine.future_buffered == 1
+    # height moved past the buffered message: taking height 3 drops it
+    engine.state.reset(3)
+    assert engine.take_future_messages(3) == []
+    assert engine.future_buffered == 0
+
+
+# -- crash-consistent finalize ordering (satellite) --------------------------
+
+
+async def _run_height_with_finalize_hook(hook):
+    engine, backend = make_engine()
+    engine.on_finalize = hook
+    for message in full_height_messages(1):
+        engine.add_message(message)
+    await asyncio.wait_for(engine.run_sequence(1), 5)
+    return engine, backend
+
+
+async def test_crash_before_wal_append_keeps_store_evidence(tmp_path):
+    """Kill-point BETWEEN insert_proposal and the WAL append: the height
+    is not yet durable, and because the prune runs strictly AFTER the
+    append, the store still holds the full commit-quorum evidence — the
+    height is re-derivable, never lost."""
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    injector = FaultInjector(11)
+    crash = CrashRestart(injector, "crash:finalize", lo=1, hi=1)
+
+    def wal_append(height, proposal, seals):
+        wal.append_finalize(height, proposal, seals)
+
+    hook = crash.wrap(wal_append, before=True)  # die short of durability
+    engine, backend = make_engine()
+    engine.on_finalize = hook
+    for message in full_height_messages(1):
+        engine.add_message(message)
+    with pytest.raises(SimulatedCrash):
+        await asyncio.wait_for(engine.run_sequence(1), 5)
+    # WAL empty -> recovery would re-run height 1 ...
+    assert WriteAheadLog(wal.path).replay().next_height == 1
+    # ... and the un-pruned store still holds the quorum evidence
+    view = View(height=1, round=0)
+    assert engine.messages.num_messages(view, MessageType.COMMIT) == 3
+    engine.messages.close()
+
+
+async def test_crash_after_wal_append_height_is_durable(tmp_path):
+    """Kill-point AFTER the WAL append (before the prune): recovery
+    resumes at height+1 — the finalized height survived the crash."""
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    injector = FaultInjector(11)
+    crash = CrashRestart(injector, "crash:finalize", lo=1, hi=1)
+    hook = crash.wrap(
+        lambda h, p, s: wal.append_finalize(h, p, s), before=False
+    )
+    engine, backend = make_engine()
+    engine.on_finalize = hook
+    for message in full_height_messages(1):
+        engine.add_message(message)
+    with pytest.raises(SimulatedCrash):
+        await asyncio.wait_for(engine.run_sequence(1), 5)
+    state = WriteAheadLog(wal.path).replay()
+    assert state.next_height == 2
+    assert state.blocks[0].proposal.raw_proposal == VALID_BLOCK
+    engine.messages.close()
+
+
+# -- restored locks ----------------------------------------------------------
+
+
+async def test_restored_lock_resumes_commit_without_reproposing():
+    """A restored proposer must NOT build a fresh proposal over its lock:
+    the sequence re-enters COMMIT with the certificate's proposal pinned
+    and re-announces its COMMIT for it."""
+    engine, backend = make_engine(our_id=b"node-0", proposer=b"node-0")
+    view = View(height=1, round=0)
+    pc = PreparedCertificate(
+        proposal_message=build_preprepare(
+            VALID_BLOCK, VALID_PROPOSAL_HASH, None, view, b"node-0"
+        ),
+        prepare_messages=[
+            build_prepare(VALID_PROPOSAL_HASH, view, n) for n in NODES[1:4]
+        ],
+    )
+    restore = RestoredState(height=1, round=0, certificate=pc)
+    # commits from the others complete the restored height
+    for sender in NODES[1:4]:
+        engine.add_message(build_commit(VALID_PROPOSAL_HASH, view, sender))
+    built = []
+    backend.build_proposal_fn = lambda v: built.append(v) or VALID_BLOCK
+    await asyncio.wait_for(engine.run_sequence(1, restore=restore), 5)
+    assert built == []  # never re-proposed
+    assert [p.raw_proposal for p, _ in backend.inserted] == [VALID_BLOCK]
+    # the restored node re-announced its COMMIT for the locked proposal
+    commits = [
+        m for m in engine.transport.sent if m.type == MessageType.COMMIT
+    ]
+    assert commits and commits[0].commit_data.proposal_hash == VALID_PROPOSAL_HASH
+    engine.messages.close()
+
+
+# -- ChainRunner lifecycle ---------------------------------------------------
+
+
+class _LoopCluster:
+    """4 mock-backend nodes driven by ChainRunners over one loopback."""
+
+    def __init__(self, tmp_path, overlap=True):
+        self.nodes = []
+        self.runners = []
+        cluster = self
+
+        class _T:
+            def multicast(self, message):
+                for engine, _ in cluster.nodes:
+                    engine.add_message(message)
+
+        for i, node_id in enumerate(NODES):
+            backend = MockBackend(node_id)
+            backend.voting_powers = {n: 1 for n in NODES}
+            backend.is_proposer_fn = (
+                lambda vid, h, r: vid == NODES[(h + r) % len(NODES)]
+            )
+            engine = IBFT(NullLogger(), backend, _T())
+            engine.set_base_round_timeout(2.0)
+            wal = WriteAheadLog(str(tmp_path / f"wal-{i}.jsonl"))
+            self.nodes.append((engine, backend))
+            self.runners.append(ChainRunner(engine, wal, overlap=overlap))
+
+    def close(self):
+        for engine, _ in self.nodes:
+            engine.messages.close()
+
+
+async def test_runner_three_heights_no_barrier(tmp_path):
+    """Tier-1 smoke: 4 nodes, 3 back-to-back heights through persistent
+    runner tasks (no gather barrier between heights anywhere), with
+    per-height chain.height + chain.handoff spans on the recorder."""
+    recorder = trace.enable()
+    try:
+        cluster = _LoopCluster(tmp_path)
+        tasks = [
+            asyncio.create_task(r.run(until_height=3)) for r in cluster.runners
+        ]
+        await asyncio.wait_for(asyncio.gather(*tasks), 30)
+        for runner, (engine, backend) in zip(cluster.runners, cluster.nodes):
+            assert runner.heights_run == 3
+            assert runner.latest_height() == 3
+            assert len(backend.inserted) == 3
+            assert len(runner.handoff_ms) == 3
+            # WAL agrees with the in-memory chain
+            state = WriteAheadLog(runner.wal.path).replay()
+            assert [b.height for b in state.blocks] == [1, 2, 3]
+        names = [record[1] for record in recorder.snapshot()]
+        assert names.count("chain.height") == 12  # 4 nodes x 3 heights
+        assert names.count("chain.handoff") == 12
+        cluster.close()
+    finally:
+        trace.disable()
+
+
+async def test_runner_rejects_concurrent_run(tmp_path):
+    cluster = _LoopCluster(tmp_path)
+    runner = cluster.runners[0]
+    task = asyncio.create_task(runner.run(until_height=99))
+    await asyncio.sleep(0.05)
+    with pytest.raises(RuntimeError):
+        await runner.run(until_height=99)
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    cluster.close()
+
+
+async def test_overlap_worker_preverifies_future_ingress(tmp_path):
+    """The cross-height overlap path in isolation: the engine sits in
+    height 1's COMMIT phase, height-2 PREPAREs are buffered; the overlap
+    worker must batch-verify them OFF the loop and land them in the store
+    as verified messages before height 2 even starts."""
+    metrics.reset()
+    engine, backend = make_engine()
+    engine.state.reset(1)
+    engine.state.change_state(StateName.COMMIT)
+    runner = ChainRunner(engine, None, overlap=True, overlap_poll_s=0.001)
+    verified = []
+    backend.is_valid_validator_fn = lambda m: verified.append(m) or True
+    early = [
+        build_prepare(VALID_PROPOSAL_HASH, View(height=2, round=0), sender)
+        for sender in NODES[:3]
+    ]
+    for message in early:
+        engine.add_message(message)
+    assert engine.future_buffered == 3
+    worker = asyncio.create_task(runner._overlap_worker())
+    try:
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            if runner.overlapped_lanes:
+                break
+        assert runner.overlapped_lanes == 3
+        assert len(verified) == 3  # verified by the worker, not at flush
+        assert engine.future_buffered == 0
+        view = View(height=2, round=0)
+        assert engine.messages.num_messages(view, MessageType.PREPARE) == 3
+    finally:
+        worker.cancel()
+        await asyncio.gather(worker, return_exceptions=True)
+    engine.messages.close()
+
+
+async def test_chain_tail_bounded_and_deep_history_served_from_wal(tmp_path):
+    """The in-memory chain is a bounded tail (run() may drive heights
+    forever); ranged requests hit an index slice, and heights evicted
+    from the tail are served to peers from the WAL."""
+    cluster = _LoopCluster(tmp_path)
+    runner = cluster.runners[0]
+    runner.max_chain_blocks = 2
+    tasks = [
+        asyncio.create_task(r.run(until_height=4)) for r in cluster.runners
+    ]
+    await asyncio.wait_for(asyncio.gather(*tasks), 30)
+    assert len(runner.chain) == 2  # tail trimmed
+    assert runner.latest_height() == 4
+    # tail range: index slice
+    assert [b.height for b in runner.get_blocks(3, 4)] == [3, 4]
+    # evicted range: WAL replay
+    assert [b.height for b in runner.get_blocks(1, 4)] == [1, 2, 3, 4]
+    cluster.close()
+
+
+async def test_lock_append_failure_withholds_commit():
+    """A COMMIT must never exist on the network without its durable lock:
+    when the lock hook raises, the engine stays locked in memory, sends NO
+    commit, and still finalizes from its peers' commits."""
+    engine, backend = make_engine()
+
+    def failing_lock(*_args):
+        raise OSError("disk full")
+
+    engine.on_lock = failing_lock
+    for message in full_height_messages(1):
+        engine.add_message(message)
+    await asyncio.wait_for(engine.run_sequence(1), 5)
+    assert [p.raw_proposal for p, _ in backend.inserted] == [VALID_BLOCK]
+    commits = [
+        m for m in engine.transport.sent if m.type == MessageType.COMMIT
+    ]
+    assert commits == [], "commit multicast despite failed lock append"
+    engine.messages.close()
+
+
+async def test_recover_resumes_next_height(tmp_path):
+    """recover() rebuilds the embedder chain from the WAL and resumes at
+    the first un-finalized height."""
+    cluster = _LoopCluster(tmp_path)
+    tasks = [
+        asyncio.create_task(r.run(until_height=2)) for r in cluster.runners
+    ]
+    await asyncio.wait_for(asyncio.gather(*tasks), 30)
+    wal_path = cluster.runners[0].wal.path
+    cluster.close()
+
+    backend = MockBackend(NODES[0])
+    backend.voting_powers = {n: 1 for n in NODES}
+    engine = IBFT(NullLogger(), backend, _RecordingTransport())
+    runner = ChainRunner(engine, WriteAheadLog(wal_path))
+    assert runner.recover() == 3
+    assert len(backend.inserted) == 2
+    assert [b.height for b in runner.chain] == [1, 2]
+    engine.messages.close()

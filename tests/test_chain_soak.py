@@ -1,0 +1,162 @@
+"""Seeded chain soaks: ChainRunner clusters under chaos drops.
+
+VERDICT item 8 + the ISSUE 5 coverage satellite: the continuous node must
+hold liveness at scale, not just in 4-node unit scenarios.  Two tiers:
+
+* tier-1 smoke — 4 nodes / 3 heights with a seeded drop/delay schedule,
+  runs in seconds on CPU;
+* slow soak — 30 nodes / 20 heights (hypothesis-drawn seeds when
+  hypothesis is installed, the pinned seed otherwise — the repo's
+  hypothesis-or-seeded convention), chaos drops enabled, block-sync
+  allowed to repair stranded tails exactly as production would.
+
+Every node must end on the SAME 20-block chain; consensus must have done
+the bulk of the work (sync only ever repairs tails), and the schedule
+must actually have injected faults.  Failures print the CHAOS-REPLAY
+artifact line like every other chaos suite.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from go_ibft_tpu.chain import ChainRunner, LoopbackSyncNetwork, SyncClient, WriteAheadLog
+from go_ibft_tpu.chaos import (
+    ChaoticDeliver,
+    FaultConfig,
+    FaultInjector,
+    replay_on_failure,
+)
+from go_ibft_tpu.core import IBFT, BatchingIngress
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend
+from go_ibft_tpu.utils import metrics
+from go_ibft_tpu.verify import HostBatchVerifier
+
+from harness import NullLogger
+
+# Same quorum-budget reasoning as tests/test_chaos.py::_SOAK_CFG: combined
+# per-delivery loss must stay well under the ~1/3 fault budget or the soak
+# measures luck, not robustness.
+_SOAK_CFG = FaultConfig(
+    drop_rate=0.02,
+    delay_rate=0.2,
+    max_delay_s=0.01,
+    duplicate_rate=0.05,
+    reorder_rate=0.05,
+)
+
+
+class _ChaosChainCluster:
+    """N ChainRunner nodes; every delivery passes a per-receiver chaos gate."""
+
+    def __init__(self, tmp_path, n, injector, *, timeout=1.0):
+        self.keys = [PrivateKey.from_seed(b"soak-%d" % i) for i in range(n)]
+        self.src = ECDSABackend.static_validators(
+            {k.address: 1 for k in self.keys}
+        )
+        self.net = LoopbackSyncNetwork()
+        self.nodes = []
+        self.runners = []
+        self._gates = []
+        cluster = self
+
+        class _T:
+            def multicast(self, message):
+                for gate in cluster._gates:
+                    gate(message)
+
+        for i, key in enumerate(self.keys):
+            core = IBFT(
+                NullLogger(),
+                ECDSABackend(key, self.src),
+                _T(),
+                batch_verifier=HostBatchVerifier(self.src),
+            )
+            core.set_base_round_timeout(timeout)
+            ingress = BatchingIngress(core.add_messages)
+            self._gates.append(
+                ChaoticDeliver(ingress.submit, injector, f"deliver:{i}")
+            )
+            self.nodes.append((core, ingress))
+            runner = ChainRunner(
+                core,
+                WriteAheadLog(os.path.join(str(tmp_path), f"wal-{i}.jsonl")),
+                sync=SyncClient(
+                    key.address, self.net, HostBatchVerifier(self.src), self.src
+                ),
+            )
+            self.net.register(key.address, runner)
+            self.runners.append(runner)
+
+    def close(self):
+        for core, ingress in self.nodes:
+            ingress.close()
+            core.messages.close()
+
+
+async def _soak(tmp_path, seed, *, n, heights, deadline, timeout=1.0):
+    metrics.reset()
+    injector = FaultInjector(seed, _SOAK_CFG)
+    with replay_on_failure(injector):
+        cluster = _ChaosChainCluster(tmp_path, n, injector, timeout=timeout)
+        try:
+            tasks = [
+                asyncio.create_task(runner.run(until_height=heights))
+                for runner in cluster.runners
+            ]
+            await asyncio.wait_for(asyncio.gather(*tasks), deadline)
+            chains = [
+                [b.proposal.raw_proposal for b in runner.chain]
+                for runner in cluster.runners
+            ]
+            assert all(len(c) == heights for c in chains), [
+                len(c) for c in chains
+            ]
+            assert all(c == chains[0] for c in chains), "chains diverged"
+            # consensus did the work; sync only repaired stranded tails
+            synced = sum(r.synced_heights for r in cluster.runners)
+            assert synced < n * heights // 2, (
+                f"sync carried {synced} heights — consensus barely ran"
+            )
+            injected = sum(
+                metrics.counters_snapshot(("go-ibft", "chaos")).values()
+            )
+            assert injected > 0, "chaos schedule injected no faults"
+        finally:
+            cluster.close()
+            # let chaotic call_later deliveries land before the leak check
+            await asyncio.sleep(0.03)
+
+
+async def test_chain_chaos_smoke(tmp_path):
+    """Tier-1: 4 ChainRunner nodes finalize 3 heights under seeded chaos."""
+    await _soak(tmp_path, seed=101, n=4, heights=3, deadline=60)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [8])
+async def test_chain_soak_30n_20h(tmp_path, seed):
+    """The 30-node / height-20 soak (VERDICT item 8), seeded fallback."""
+    await _soak(tmp_path, seed=seed, n=30, heights=20, deadline=600, timeout=3.0)
+
+
+try:  # hypothesis-drawn seeds when available (repo convention: optional)
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    @pytest.mark.slow
+    @settings(
+        max_examples=1,
+        deadline=None,
+        suppress_health_check=[HealthCheck.function_scoped_fixture],
+    )
+    @given(seed=st.integers(min_value=0, max_value=2**16))
+    def test_chain_soak_30n_20h_hypothesis(tmp_path, seed):
+        asyncio.run(
+            _soak(tmp_path, seed=seed, n=30, heights=20, deadline=600, timeout=3.0)
+        )
+
+except ImportError:  # hypothesis absent: the pinned-seed soak above stands
+    pass

@@ -1,7 +1,7 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
-	warm cluster-bench obs-report chain-soak
+	warm cluster-bench obs-report chain-soak mesh-bench compile-budget
 
 test:
 	python -m pytest tests/ -q
@@ -26,6 +26,24 @@ native:
 
 bench:
 	python bench.py
+
+# Mesh-sharding bench (config #8) on forced host devices: exercises the
+# SHARDED verify route in CI without TPU hardware.  The persistent XLA
+# cache absorbs the shard_map compiles after the first run.  Budget
+# note: the XLA:CPU ladder costs ~69 ms/lane on a 1-core host, so the
+# default 2048-lane sweep runs ~25 min cold; the 1800 s budget skips
+# whatever doesn't fit with explicit notes (rc stays 0).
+# GO_IBFT_MESH_LANES=8192 opts into the full acceptance shape.
+mesh-bench:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	GO_IBFT_MESH_BENCH=1 GO_IBFT_BENCH_BUDGET_S=1800 \
+	python bench.py --mesh-only
+
+# Stablehlo-line budgets for the hot programs, incl. the mesh program at
+# dp=2/4/8 (trace size IS cold-compile time on XLA:CPU)
+compile-budget:
+	python scripts/compile_budget.py
 
 # Regression gates: fresh bench evidence (bench_evidence.jsonl) vs the
 # best prior BENCH_r*.json on the same backend (go_ibft_tpu/obs/gates.py)

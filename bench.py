@@ -14,6 +14,10 @@ Configs (BASELINE.json):
   #6  chaos drain: degraded-mode overhead under a fixed fault schedule
   #7  chain sustained: 4-node ChainRunner cluster, 20 back-to-back
       heights, overlap on/off + per-height handoff overhead
+  #8  mesh sharded drain: 8k multi-height seal lanes across the device
+      mesh (dp=2/4/8) vs single-device; `--mesh-only` + GO_IBFT_MESH_BENCH
+      (the `make mesh-bench` path) exercises the sharded route on forced
+      host devices without TPU hardware
 
 Prints one JSON line per config; the HEADLINE line (config #2, the
 ``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST on
@@ -1014,6 +1018,216 @@ def config7_chain() -> None:
     )
 
 
+def config8_mesh() -> None:
+    """Sharded verify data plane (config #8): multi-height seal-lane drain
+    across the device mesh, sharded vs single-device.
+
+    The drain shape is the block-sync / multi-chain coalesced one —
+    ``verify_seal_lanes`` with per-lane proposal hashes spanning several
+    heights — at 4k-10k lanes (``GO_IBFT_MESH_LANES``, default 8192),
+    routed through (a) a single-device ``DeviceBatchVerifier`` (chunked
+    full-bucket dispatches) and (b) a ``MeshBatchVerifier`` per dp in
+    ``GO_IBFT_MESH_DP`` (default 2,4,8; filtered by visible devices).
+    Every route's mask is gated against the sequential oracle before any
+    timing.  The evidence line carries ``mesh_devices`` /
+    ``lanes_per_device`` / ``reduce_ms`` (the host-side quorum reduce)
+    plus one sub-record per route — config #7's one-line-many-variants
+    shape, so the rc=0 evidence contract stays one line per config.
+
+    Honesty rules: the CPU-fallback branch does NO device work (the r04
+    lesson) unless ``GO_IBFT_MESH_BENCH=1`` explicitly opts in (the
+    ``make mesh-bench`` path, which forces
+    ``--xla_force_host_platform_device_count`` so the SHARDED route
+    exercises in CI without TPU hardware); without the opt-in both routes
+    are measured on the host verifier and labeled as such, with the
+    sharded route honestly recorded as degraded-to-single-device.  On a
+    1-core host the forced devices time-slice one core, so sharded
+    throughput has no parallel ceiling — ``cpus`` is recorded and the gap
+    is explained in docs/PERFORMANCE.md.
+    """
+    from go_ibft_tpu.bench import build_seal_lane_workload
+    from go_ibft_tpu.verify.batch import host_quorum_reached
+
+    forced = os.environ.get("GO_IBFT_MESH_BENCH") == "1"
+    run_real = forced or not _FALLBACK
+    # Default lane counts by branch: 8192 (the acceptance shape) on a live
+    # TPU; 2048 on forced-CPU runs — a 1-core host pays ~40 s per
+    # 2048-lane XLA:CPU ladder dispatch, so the 8k sweep is an explicit
+    # GO_IBFT_MESH_LANES=8192 opt-in there (docs/PERFORMANCE.md records
+    # one); 512 host-route lanes on the no-device-work fallback.
+    if not _FALLBACK:
+        default_lanes = "8192"
+    elif forced:
+        default_lanes = "2048"
+    else:
+        default_lanes = "512"
+    lanes_target = int(os.environ.get("GO_IBFT_MESH_LANES", default_lanes))
+    if not run_real:
+        lanes_target = min(lanes_target, _host_scale(512, 16))
+    w = build_seal_lane_workload(
+        lanes_target,
+        n_validators=_host_scale(100, 8),
+        heights=4,
+        corrupt_frac=0.05,
+        seed=8,
+    )
+    lanes, src, height = w.lanes, w.validators, w.height
+    # What the host-side reduce MUST conclude from the oracle mask (True
+    # at the default sizes — 95% of a full-coverage lane set quorums; a
+    # tiny GO_IBFT_MESH_LANES run may honestly not cover the quorum).
+    expected_reached = host_quorum_reached(
+        src,
+        [
+            seal.signer
+            for (_h, seal), ok in zip(lanes, w.expected_mask)
+            if ok
+        ],
+        height,
+        None,
+    )
+
+    def reduce_ms_of(mask) -> float:
+        t0 = time.perf_counter()
+        reached = host_quorum_reached(
+            src, [seal.signer for (_h, seal), ok in zip(lanes, mask) if ok],
+            height, None,
+        )
+        assert reached == expected_reached, "quorum reduce diverged from oracle"
+        return (time.perf_counter() - t0) * 1e3
+
+    def timed_route(verifier, reps: int) -> dict:
+        mask = np.asarray(verifier.verify_seal_lanes(lanes, height))
+        assert (mask == w.expected_mask).all(), (
+            "route mask diverges from the sequential oracle"
+        )
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            verifier.verify_seal_lanes(lanes, height)
+            times.append((time.perf_counter() - t0) * 1e3)
+        p50 = statistics.median(times)
+        return {
+            "p50_ms": round(p50, 3),
+            "lanes_per_s": round(len(lanes) / (p50 / 1e3), 1),
+            "reduce_ms": round(reduce_ms_of(mask), 3),
+        }
+
+    routes = {}
+    if run_real:
+        from go_ibft_tpu.parallel import mesh_context
+        from go_ibft_tpu.verify import DeviceBatchVerifier, MeshBatchVerifier
+
+        devices = jax.devices()
+        reps = 3 if (_FALLBACK or forced) else _reps()
+        routes["single_device"] = timed_route(DeviceBatchVerifier(src), reps)
+        if len(devices) < 2:
+            # A 1-device host (the standing single-chip TPU tunnel) has no
+            # sharded layout: the mesh route degrades to single-device BY
+            # CONTRACT, so record that degradation as a MEASURED entry
+            # (the single-device numbers ARE what the mesh route runs)
+            # instead of silently dropping the route the config exists to
+            # measure.
+            routes["sharded"] = dict(
+                routes["single_device"],
+                mesh_devices=1,
+                degraded=True,
+                note=(
+                    "1 device visible: MeshBatchVerifier degrades to the "
+                    "single-device path (measured above)"
+                ),
+            )
+        dp_list = [
+            int(d)
+            for d in os.environ.get("GO_IBFT_MESH_DP", "2,4,8").split(",")
+            if d.strip()
+        ]
+        if len(devices) < 2:
+            dp_list = []
+        for dp in dp_list:
+            key = f"dp{dp}"
+            if dp > len(devices):
+                routes[key] = {"note": f"skipped: {len(devices)} devices visible"}
+                continue
+            if _remaining_s() < 60.0:
+                routes[key] = {
+                    "note": f"skipped: {_remaining_s():.0f}s of budget left"
+                }
+                continue
+            mesh = mesh_context(dp, devices=devices[:dp])
+            mv = MeshBatchVerifier(src, mesh=mesh)
+            if not mv.sharded:
+                routes[key] = {"note": "skipped: mesh degenerated to 1 device"}
+                continue
+            entry = timed_route(mv, reps)
+            # Per-DISPATCH shard width: _pad_lanes is only defined up to
+            # the chunk cap (a drain above it splits into cap-sized
+            # dispatches), so pad the largest chunk, not the total.
+            chunk = min(len(lanes), mv._dispatch_cap)
+            entry["lanes_per_device"] = mv._pad_lanes(chunk) // dp
+            routes[key] = entry
+    else:
+        # No-device-work fallback: both routes measured on the host
+        # verifier, the sharded one explicitly recorded as degraded (a
+        # 1-device MeshBatchVerifier IS the single-device path; standing
+        # it in with the host route keeps the no-XLA pledge).
+        from go_ibft_tpu.verify import HostBatchVerifier
+
+        host = HostBatchVerifier(src)
+        single = timed_route(host, 3)
+        single["variant"] = "host-routed (CPU fallback, no device work)"
+        routes["single_device"] = single
+        routes["sharded"] = dict(
+            single,
+            mesh_devices=1,
+            degraded=True,
+            note=(
+                "mesh route degrades to single-device off the fallback "
+                "branch; set GO_IBFT_MESH_BENCH=1 (make mesh-bench) to "
+                "exercise the sharded path on forced host devices"
+            ),
+        )
+
+    sharded_routes = {
+        k: v for k, v in routes.items() if k.startswith("dp") and "p50_ms" in v
+    }
+    single = routes.get("single_device", {})
+    if sharded_routes:
+        best_dp = max(
+            sharded_routes, key=lambda k: sharded_routes[k]["lanes_per_s"]
+        )
+        best = sharded_routes[best_dp]
+        mesh_devices = int(best_dp[2:])
+        value = best["lanes_per_s"]
+        speedup = (
+            round(value / single["lanes_per_s"], 3)
+            if single.get("lanes_per_s")
+            else None
+        )
+        lanes_per_device = best.get("lanes_per_device")
+        reduce_ms = best["reduce_ms"]
+    else:
+        mesh_devices = 1
+        value = single.get("lanes_per_s")
+        speedup = None
+        lanes_per_device = len(lanes)
+        reduce_ms = single.get("reduce_ms")
+    _log(
+        {
+            "metric": config8_mesh.metric,
+            "value": value,
+            "unit": "lanes/s",
+            "vs_baseline": speedup,
+            "baseline": "single-device chunked drain, same lanes",
+            "lanes": len(lanes),
+            "mesh_devices": mesh_devices,
+            "lanes_per_device": lanes_per_device,
+            "reduce_ms": reduce_ms,
+            "routes": routes,
+            "cpus": os.cpu_count(),
+        }
+    )
+
+
 def config2_host_fallback() -> None:
     """Config #2 CPU-fallback variant: whole-round verify on the host route.
 
@@ -1258,6 +1472,7 @@ config4_bls.metric = "bls_aggregate_verify_p50_100v"
 config5_byzantine_mix.metric = "byzantine_300v_30pct_prepare_commit_p50"
 config6_chaos.metric = "chaos_degraded_overhead_100v"
 config7_chain.metric = "chain_sustained_20h_100v"
+config8_mesh.metric = "mesh_sharded_drain_8k_100v"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -1278,8 +1493,9 @@ _FALLBACK_SCHEDULE = (
     (config4_host_scaled, 150.0),
     (config5_host_scaled, 120.0),
     (config6_chaos, 95.0),
-    (config7_chain, 50.0),
-    (config2_host_fallback, 45.0),
+    (config7_chain, 55.0),
+    (config8_mesh, 45.0),
+    (config2_host_fallback, 40.0),
     (config1_happy_path, 0.0),
 )
 _DEVICE_SCHEDULE = (
@@ -1288,7 +1504,8 @@ _DEVICE_SCHEDULE = (
     (config4_bls, 390.0),
     (config5_byzantine_mix, 350.0),
     (config6_chaos, 330.0),
-    (config7_chain, 300.0),
+    (config7_chain, 310.0),
+    (config8_mesh, 300.0),
 )
 
 
@@ -1338,6 +1555,14 @@ def main(argv=None) -> None:
         default=os.environ.get("GO_IBFT_EVIDENCE_PATH", "bench_evidence.jsonl"),
         help="per-config evidence JSONL (append-only, flushed per record)",
     )
+    parser.add_argument(
+        "--mesh-only",
+        action="store_true",
+        help="run ONLY the mesh-sharding config (#8); the rc=0 evidence "
+        "contract scopes to it (the `make mesh-bench` entry point, which "
+        "forces host devices so the sharded path exercises without TPU "
+        "hardware)",
+    )
     args = parser.parse_args(argv)
     if args.trace:
         obs_trace.enable()
@@ -1380,10 +1605,26 @@ def _run(args) -> None:
         args.evidence,
         backend="cpu-fallback" if _FALLBACK else "tpu",
         probe=_FINGERPRINT.probe if _FINGERPRINT is not None else "error",
+        devices=getattr(_FINGERPRINT, "device_count", None),
         truncate=True,
     )
     enable_persistent_cache()
     _log({"metric": "bench_platform", "value": platform})
+
+    if args.mesh_only:
+        # Scoped run for `make mesh-bench`: only config #8, rc=0 iff its
+        # evidence line landed.  The config gates its own masks against
+        # the sequential oracle, so no separate differential smoke is
+        # needed (and the smoke's device compiles are exactly what a
+        # forced-CPU mesh run must not pay twice).
+        failures = []
+        _guarded(config8_mesh, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config8_mesh.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
 
     if _FALLBACK:
         # Honest-degraded path: NO device work of any kind (r04 died at

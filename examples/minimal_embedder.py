@@ -39,7 +39,9 @@ class StdoutLogger:
         print(f"[error] {msg} {args if args else ''}")
 
 
-def build_cluster(n: int, use_device: bool, use_bls: bool = False):
+def build_cluster(
+    n: int, use_device: bool, use_bls: bool = False, use_mesh: bool = False
+):
     # 1. Validator identities and the (static) voting-power map.
     keys = [PrivateKey.from_seed(b"example-validator-%d" % i) for i in range(n)]
     powers = {k.address: 1 for k in keys}
@@ -73,7 +75,28 @@ def build_cluster(n: int, use_device: bool, use_bls: bool = False):
             # would assemble transactions here (reference Backend.BuildProposal).
             backend = ECDSABackend(key, validators, build_proposal_fn=build)
         batch_verifier = None
-        if use_device:
+        if use_mesh:
+            # Production scale-out posture: the adaptive router with the
+            # sharded mesh rung on top — tiny drains stay on host, large
+            # ones on one device, drains past the mesh cutover shard
+            # lane-parallel across every visible device (forced host
+            # devices work too: XLA_FLAGS=--xla_force_host_platform_
+            # device_count=8).  Degrades transparently to the plain
+            # device ladder on a 1-device host.  The engine certify
+            # drains AND (in --chain mode) the overlap/sync drains all
+            # route through the same ladder.
+            from go_ibft_tpu.verify import (
+                AdaptiveBatchVerifier,
+                MeshBatchVerifier,
+            )
+
+            mesh_verifier = MeshBatchVerifier(validators)
+            batch_verifier = AdaptiveBatchVerifier(
+                validators,
+                mesh=mesh_verifier if mesh_verifier.sharded else None,
+            )
+            batch_verifier.warmup()
+        elif use_device:
             from go_ibft_tpu.verify import DeviceBatchVerifier
 
             batch_verifier = DeviceBatchVerifier(validators)
@@ -99,9 +122,13 @@ def build_cluster(n: int, use_device: bool, use_bls: bool = False):
 
 
 async def main_async(
-    n: int, heights: int, use_device: bool, use_bls: bool = False
+    n: int,
+    heights: int,
+    use_device: bool,
+    use_bls: bool = False,
+    use_mesh: bool = False,
 ) -> None:
-    engines = build_cluster(n, use_device, use_bls)
+    engines = build_cluster(n, use_device, use_bls, use_mesh)
     try:
         for h in range(1, heights + 1):
             # Every validator runs the height concurrently; run_sequence
@@ -115,7 +142,11 @@ async def main_async(
 
 
 async def main_chain(
-    n: int, heights: int, use_device: bool, use_bls: bool = False
+    n: int,
+    heights: int,
+    use_device: bool,
+    use_bls: bool = False,
+    use_mesh: bool = False,
 ) -> None:
     """The continuous-node mode: one ChainRunner per validator.
 
@@ -139,7 +170,7 @@ async def main_chain(
     )
     from go_ibft_tpu.verify import HostBatchVerifier
 
-    engines = build_cluster(n, use_device, use_bls)
+    engines = build_cluster(n, use_device, use_bls, use_mesh)
     network = LoopbackSyncNetwork()
     runners = []
     with tempfile.TemporaryDirectory() as tmp:
@@ -192,6 +223,13 @@ if __name__ == "__main__":
         help="verify PREPARE/COMMIT phases through the fused device kernels",
     )
     ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="shard large verify drains lane-parallel across the device "
+        "mesh (AdaptiveBatchVerifier + MeshBatchVerifier; degrades to "
+        "--device behavior on a 1-device host)",
+    )
+    ap.add_argument(
         "--bls",
         action="store_true",
         help="BLS12-381 committed seals (one pairing certifies a quorum)",
@@ -205,4 +243,6 @@ if __name__ == "__main__":
     )
     args = ap.parse_args()
     runner = main_chain if args.chain else main_async
-    asyncio.run(runner(args.nodes, args.heights, args.device, args.bls))
+    asyncio.run(
+        runner(args.nodes, args.heights, args.device, args.bls, args.mesh)
+    )

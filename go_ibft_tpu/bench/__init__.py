@@ -2,14 +2,18 @@
 
 from .workload import (
     RoundWorkload,
+    SealLaneWorkload,
     SignedRound,
     build_round_workload,
+    build_seal_lane_workload,
     build_signed_round,
 )
 
 __all__ = [
     "RoundWorkload",
+    "SealLaneWorkload",
     "SignedRound",
     "build_round_workload",
+    "build_seal_lane_workload",
     "build_signed_round",
 ]

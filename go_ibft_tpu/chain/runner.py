@@ -341,7 +341,13 @@ class ChainRunner:
         executor thread — the engine's event loop keeps draining COMMIT
         wakeups while the envelopes for H+1 verify concurrently; on the
         device route the drain itself is the double-buffered
-        ``verify/pipeline.py`` chunk pipeline.
+        ``verify/pipeline.py`` chunk pipeline, and when the engine's
+        verifier carries the sharded mesh route
+        (:class:`~go_ibft_tpu.verify.mesh_batch.MeshBatchVerifier`, alone
+        or as the Adaptive ladder's fast rung) the whole buffered batch
+        coalesces into lane-parallel sharded dispatches — the route is the
+        verifier's decision, invisible here, exactly like the
+        host/device split.
         """
         loop = asyncio.get_running_loop()
         engine = self.engine

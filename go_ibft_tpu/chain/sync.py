@@ -104,9 +104,14 @@ class SyncClient:
     """Fetch-and-verify catch-up for one node.
 
     ``verifier`` is any object with ``verify_seal_lanes(lanes, height)``
-    (Host/Device/Resilient/Adaptive all implement it); verdicts are pinned
-    to the sequential host oracle by the conformance tests, so a device
-    route can never accept a range the reference semantics would reject.
+    (Host/Device/Mesh/Resilient/Adaptive all implement it); verdicts are
+    pinned to the sequential host oracle by the conformance tests, so a
+    device route can never accept a range the reference semantics would
+    reject.  A :class:`~go_ibft_tpu.verify.mesh_batch.MeshBatchVerifier`
+    (or an Adaptive ladder carrying one) coalesces a whole multi-height
+    range into ONE sharded dispatch — its chunk capacity is ``largest
+    lane bucket x device count`` — so catch-up cost scales down with the
+    mesh instead of serializing per 2048-lane chunk.
     """
 
     def __init__(

@@ -36,7 +36,7 @@ import time
 from dataclasses import asdict, dataclass
 from typing import List, Optional, Sequence, Tuple
 
-from ..utils.probe import probe_default_backend
+from ..utils.probe import probe_default_backend_full
 
 __all__ = [
     "Fingerprint",
@@ -77,6 +77,10 @@ class Fingerprint:
     # Environment pin: a fingerprint probed under an explicit platform pin
     # (JAX_PLATFORMS) must not serve a differently-pinned process.
     env_platforms: str = ""
+    # How many devices the probed backend exposed (None when dead or when
+    # the probe stub predates the DEVICES= line).  Evidence lines carry it
+    # so dp=1 and dp>1 runs of the mesh configs are distinguishable.
+    device_count: Optional[int] = None
 
     def is_tpu(self) -> bool:
         return self.platform in TPU_PLATFORMS
@@ -139,7 +143,7 @@ def probe_fingerprint(
         if cached is not None:
             cached.probe = "cached"
             return cached
-    platform, detail = probe_default_backend(timeout_s)
+    platform, detail, device_count = probe_default_backend_full(timeout_s)
     if platform is not None:
         status = "ok"
     elif detail.startswith("probe timeout"):
@@ -152,6 +156,7 @@ def probe_fingerprint(
         detail=detail,
         probed_at=time.time(),
         env_platforms=os.environ.get("JAX_PLATFORMS", ""),
+        device_count=device_count,
     )
     _store_cached(path, fp)
     return fp
@@ -174,17 +179,25 @@ class EvidenceWriter:
         *,
         backend: str = "cpu-fallback",
         probe: str = "error",
+        devices: Optional[int] = None,
         truncate: bool = False,
     ) -> None:
         self.path = path
         self.backend = backend
         self.probe = probe
+        # Probed device count (Fingerprint.device_count): stamped on every
+        # line so mesh-config evidence distinguishes dp=1 from dp>1 runs.
+        self.devices = devices
         self._fh = open(path, "w" if truncate else "a")
         self._configs: List[str] = []
 
-    def set_provenance(self, backend: str, probe: str) -> None:
+    def set_provenance(
+        self, backend: str, probe: str, devices: Optional[int] = None
+    ) -> None:
         self.backend = backend
         self.probe = probe
+        if devices is not None:
+            self.devices = devices
 
     def record(self, config: str, line: Optional[dict] = None, **fields) -> dict:
         """Append one evidence line for ``config``; returns the full record."""
@@ -194,6 +207,7 @@ class EvidenceWriter:
         rec["config"] = config
         rec.setdefault("backend", self.backend)
         rec.setdefault("probe", self.probe)
+        rec.setdefault("devices", self.devices)
         rec["ts"] = time.time()
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()

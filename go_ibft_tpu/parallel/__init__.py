@@ -14,6 +14,16 @@ voting-power sum riding ICI) from sharding annotations alone — no
 hand-written NCCL analogue, per the scaling-book recipe.
 """
 
-from .mesh import make_mesh, mesh_quorum_certify, mesh_seal_quorum_certify
+from .mesh import (
+    make_mesh,
+    mesh_context,
+    mesh_quorum_certify,
+    mesh_seal_quorum_certify,
+)
 
-__all__ = ["make_mesh", "mesh_quorum_certify", "mesh_seal_quorum_certify"]
+__all__ = [
+    "make_mesh",
+    "mesh_context",
+    "mesh_quorum_certify",
+    "mesh_seal_quorum_certify",
+]

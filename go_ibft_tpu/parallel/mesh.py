@@ -58,7 +58,12 @@ def shard_map(*args, check_vma=False, **kwargs):
 
 from ..ops import quorum
 
-__all__ = ["make_mesh", "mesh_quorum_certify", "mesh_seal_quorum_certify"]
+__all__ = [
+    "make_mesh",
+    "mesh_context",
+    "mesh_quorum_certify",
+    "mesh_seal_quorum_certify",
+]
 
 
 def make_mesh(
@@ -85,6 +90,51 @@ def make_mesh(
         raise ValueError(f"{n} devices not divisible by vp={vp}")
     arr = np.asarray(devices).reshape(n // vp, vp)
     return Mesh(arr, ("dp", "vp"))
+
+
+def mesh_context(
+    dp: Optional[int] = None, *, vp: int = 1, devices=None
+) -> Optional[Mesh]:
+    """Best-effort ``(dp, vp)`` mesh over whatever devices are visible.
+
+    The ONE mesh-construction path shared by
+    :class:`~go_ibft_tpu.verify.mesh_batch.MeshBatchVerifier`, the
+    ``__graft_entry__`` dryrun, and the test/bench harnesses — so device
+    enumeration, the 1-device fallback, and platform pinning can never
+    drift between them:
+
+    * **Device enumeration.**  ``devices`` wins when given; otherwise
+      ``jax.devices()`` under whatever platform pin is in force
+      (``JAX_PLATFORMS`` / ``jax.config.update("jax_platforms", ...)`` —
+      this function never overrides the ambient pin).  When the default
+      platform shows fewer devices than ``dp * vp`` asks for, the host CPU
+      devices are tried (``--xla_force_host_platform_device_count`` makes
+      multi-chip layouts testable on any host).
+    * **dp selection.**  ``dp=None`` takes every visible device (after
+      reserving ``vp``); an explicit ``dp`` is clamped to what exists.
+    * **1-device fallback.**  Returns ``None`` when no layout with more
+      than one device exists — the signal for callers to degrade to the
+      single-device path instead of paying shard_map overhead for a
+      1-shard mesh.  A dead backend (``jax.devices()`` raising) also
+      returns ``None``: mesh construction must never take a node down.
+    """
+    want = None if dp is None else dp * vp
+    if devices is None:
+        try:
+            devices = jax.devices()
+        except RuntimeError:
+            return None
+        if want is not None and len(devices) < want:
+            try:
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                pass
+    n = len(devices) if want is None else min(want, len(devices))
+    # Round dp down to what divides cleanly over vp.
+    n -= n % max(vp, 1)
+    if n // max(vp, 1) < 2:
+        return None
+    return make_mesh(n, vp=vp, devices=devices[:n])
 
 
 def _finish(reached_inputs):

@@ -51,7 +51,8 @@ _PROBE_SRC = (
     "import jax, jax.numpy as jnp;"
     "d = jax.devices();"
     "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready();"
-    "print('PLATFORM=' + d[0].platform)"
+    "print('PLATFORM=' + d[0].platform);"
+    "print('DEVICES=%d' % len(d))"
 )
 
 
@@ -80,6 +81,22 @@ def probe_default_backend(
     platform name (``"axon"``/``"tpu"``/``"cpu"``/...) or ``None`` when the
     backend is dead, with ``detail`` a one-line reason for the log.
     """
+    platform, detail, _devices = probe_default_backend_full(timeout_s)
+    return platform, detail
+
+
+def probe_default_backend_full(
+    timeout_s: Optional[float] = None,
+) -> Tuple[Optional[str], str, Optional[int]]:
+    """:func:`probe_default_backend` plus the probed device count.
+
+    The third element is how many devices the live backend exposed (so
+    evidence fingerprints can distinguish dp=1 from dp>1 runs —
+    ``--xla_force_host_platform_device_count`` and multi-chip TPU slices
+    both show up here), or ``None`` when the backend is dead or the probe
+    stub predates the ``DEVICES=`` line (the ``GO_IBFT_PROBE_SRC`` test
+    hook).
+    """
     if timeout_s is None:
         timeout_s = probe_timeout_s()
     try:
@@ -90,12 +107,21 @@ def probe_default_backend(
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return None, f"probe timeout after {timeout_s:.0f}s"
+        return None, f"probe timeout after {timeout_s:.0f}s", None
+    platform = None
+    devices: Optional[int] = None
     for line in out.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1], "ok"
+            platform = line.split("=", 1)[1]
+        elif line.startswith("DEVICES="):
+            try:
+                devices = int(line.split("=", 1)[1])
+            except ValueError:
+                devices = None
+    if platform is not None:
+        return platform, "ok", devices
     err = (out.stderr.strip().splitlines() or ["no output"])[-1][:200]
-    return None, err
+    return None, err, None
 
 
 _memo: dict = {}

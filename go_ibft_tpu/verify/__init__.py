@@ -10,6 +10,9 @@ stage 4):
 * :class:`DeviceBatchVerifier` — one ``jit`` batch per phase on whatever
   JAX backend is active (TPU in production, CPU in tests); the framework's
   headline capability.
+* :class:`MeshBatchVerifier` — the same drains sharded lane-parallel
+  across the device mesh (shard_map, host-side quorum reduce); degrades
+  transparently to :class:`DeviceBatchVerifier` on a 1-device host.
 * :class:`AdaptiveBatchVerifier` — routes tiny batches to the host path
   and big ones to the device kernels (the dispatch-latency floor makes
   device batching a loss below ~a dozen lanes).
@@ -30,6 +33,7 @@ from .batch import (
     ResilientBatchVerifier,
     SIG_BYTES,
 )
+from .mesh_batch import MeshBatchVerifier
 from .pipeline import CircuitBreaker, PackCache, VerifyPipeline
 
 __all__ = [
@@ -38,6 +42,7 @@ __all__ = [
     "DeviceBatchVerifier",
     "HostBatchVerifier",
     "MalformedLaneError",
+    "MeshBatchVerifier",
     "PackCache",
     "ResilientBatchVerifier",
     "VerifyPipeline",

@@ -88,6 +88,46 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
 
 
+def _lane_count(n: int, pad_lanes: int = 0) -> int:
+    """Static lane dimension for an ``n``-lane pack.
+
+    The packers used to assume the caller's batch fits a bucket —
+    ``max(_bucket(n), pad_lanes)`` — which made any explicitly-padded
+    shape above the largest bucket (a mesh drain padding to a multiple of
+    the device count, e.g. 4096 global lanes on dp=2) an error instead of
+    a shape.  An explicit ``pad_lanes >= n`` now PINS the lane dimension
+    exactly (the caller owns the padding policy; pad lanes are dead —
+    ``live`` False — so no dummy verdict can leak into a quorum count);
+    otherwise the next bucket serves as before."""
+    if pad_lanes >= max(n, 1):
+        return pad_lanes
+    return max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+
+
+def host_quorum_reached(
+    validators_for_height: "ValidatorSource",
+    valid_addrs: Iterable[bytes],
+    height: int,
+    threshold: Optional[int],
+) -> bool:
+    """Exact host-int voting-power quorum over a drain's valid addresses.
+
+    The ONE host-side quorum reduction shared by
+    :class:`AdaptiveBatchVerifier`'s fallback routes and the mesh
+    verifier's sharded certify paths (``ops/quorum.py`` ``power_reduce``
+    semantics: distinct validators counted once, exact Python ints for any
+    power range)."""
+    powers = validators_for_height(height)
+    thr = (
+        calculate_quorum(sum(powers.values()))
+        if threshold is None
+        else threshold
+    )
+    if thr <= 0:
+        return True
+    return sum(powers.get(a, 0) for a in set(valid_addrs)) >= thr
+
+
 def split_signature(sig: bytes) -> Tuple[int, int, int]:
     """65-byte ``r || s || v`` -> ints; raises on wrong length."""
     if len(sig) != SIG_BYTES:
@@ -423,7 +463,7 @@ def pack_sender_batch(
             raise MalformedLaneError(i, "signature", SIG_BYTES, len(m.signature))
         if len(m.sender) != ADDRESS_BYTES:
             raise MalformedLaneError(i, "sender", ADDRESS_BYTES, len(m.sender))
-    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    bb = _lane_count(n, pad_lanes)
     nl = sec.FIELD.nlimbs
     r_limbs = np.zeros((bb, nl), dtype=np.int32)
     s_limbs = np.zeros((bb, nl), dtype=np.int32)
@@ -509,7 +549,7 @@ def pack_seal_batch(proposal_hash: bytes, seals: Sequence[CommittedSeal], pad_la
         if len(s.signer) != ADDRESS_BYTES:
             raise MalformedLaneError(i, "signer", ADDRESS_BYTES, len(s.signer))
     n = len(seals)
-    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    bb = _lane_count(n, pad_lanes)
     hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
     hash_zw = np.broadcast_to(hw, (bb, 8)).copy()
     nl = sec.FIELD.nlimbs
@@ -560,7 +600,7 @@ def pack_seal_lanes(
     """
     validate_seal_lanes(lanes)
     n = len(lanes)
-    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    bb = _lane_count(n, pad_lanes)
     nl = sec.FIELD.nlimbs
     hash_zw = np.zeros((bb, 8), dtype=np.uint32)
     r_limbs = np.zeros((bb, nl), dtype=np.int32)
@@ -598,7 +638,7 @@ def _pack_sender_batch_reference(
 ):
     """Per-message loop twin of :func:`pack_sender_batch`."""
     n = len(msgs)
-    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    bb = _lane_count(n, pad_lanes)
     if payloads is None:
         payloads = [m.encode(include_signature=False) for m in msgs]
     max_len = max(len(p) for p in payloads)
@@ -634,7 +674,7 @@ def _pack_seal_batch_reference(
 ):
     """Per-message loop twin of :func:`pack_seal_batch`."""
     n = len(seals)
-    bb = max(_bucket(n, _BATCH_BUCKETS), pad_lanes)
+    bb = _lane_count(n, pad_lanes)
     hw = np.frombuffer(proposal_hash, ">u4")[::-1].astype(np.uint32)  # LE words
     hash_zw = np.broadcast_to(hw, (bb, 8)).copy()
     rs, ss, vs = [], [], []
@@ -670,6 +710,15 @@ class DeviceBatchVerifier:
 
         enable_persistent_cache()
         self._validators = validators_for_height
+        # One full dispatch's lane capacity: floods above it chunk into
+        # multiple dispatches riding the double-buffered pipeline.  The
+        # mesh subclass raises it to ``largest bucket x dp`` so a multi-
+        # height drain coalesces into ONE sharded dispatch instead of dp
+        # sequential single-device ones.
+        self._dispatch_cap = _BATCH_BUCKETS[-1]
+        # Obs route label: the mesh subclass overrides to "mesh" so every
+        # span a drain emits names the route that actually served it.
+        self._route = "device"
         self._tables: Dict[int, Tuple[np.ndarray, List[bytes]]] = {}
         # Device-resident twins of the packed tables/power vectors: uploaded
         # once per height and reused by every dispatch of that height
@@ -853,6 +902,14 @@ class DeviceBatchVerifier:
             and len(seal.signature) == SIG_BYTES
         )
 
+    def _pad_lanes(self, n: int) -> int:
+        """Minimum packed lane count for an ``n``-lane dispatch.
+
+        0 on a single device (the packers bucket freely); the mesh
+        subclass returns the smallest bucket-aligned multiple of the
+        device count so every shard gets an identical local shape."""
+        return 0
+
     def _dispatch_async(self, inputs, table, quorum_args):
         """Queue the recover (mask-only) or certify (mask+quorum) kernel.
 
@@ -914,6 +971,7 @@ class DeviceBatchVerifier:
     _MAX_DEVICE_PAYLOAD = _BLOCK_BUCKETS[-1] * dk.RATE_BYTES - 1
 
     def _sender_inputs(self, msgs: List[IbftMessage], pad_lanes: int = 0):
+        pad_lanes = max(pad_lanes, self._pad_lanes(len(msgs)))
         with trace.span("verify.pack", kind="senders", lanes=len(msgs)):
             return self._sender_inputs_impl(msgs, pad_lanes)
 
@@ -965,9 +1023,12 @@ class DeviceBatchVerifier:
                 zw[i] = np.frombuffer(digest, ">u4")[::-1].astype(np.uint32)
         return zw, r, s, v, senders, live
 
-    def _seal_inputs(self, proposal_hash: bytes, seals: List[CommittedSeal]):
+    def _seal_inputs(
+        self, proposal_hash: bytes, seals: List[CommittedSeal], pad_lanes: int = 0
+    ):
+        pad_lanes = max(pad_lanes, self._pad_lanes(len(seals)))
         with trace.span("verify.pack", kind="seals", lanes=len(seals)):
-            return pack_seal_batch(proposal_hash, seals)
+            return pack_seal_batch(proposal_hash, seals, pad_lanes=pad_lanes)
 
     # -- fused mask + quorum (the engine's phase hot path) --------------
 
@@ -1173,9 +1234,9 @@ class DeviceBatchVerifier:
         # chunks ride the double-buffered pipeline: chunk N+1 packs on host
         # while chunk N executes.
         items = [
-            (height, idxs[start : start + _BATCH_BUCKETS[-1]])
+            (height, idxs[start : start + self._dispatch_cap])
             for height, idxs in by_height.items()
-            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+            for start in range(0, len(idxs), self._dispatch_cap)
         ]
         if not items:
             return out
@@ -1189,7 +1250,7 @@ class DeviceBatchVerifier:
             )
 
         with trace.span(
-            "verify.drain", route="device", kind="senders", chunks=len(items)
+            "verify.drain", route=self._route, kind="senders", chunks=len(items)
         ):
             results = self._run_chunk_pipeline(items, pack, "verify_senders_ms")
             # Mask-only drain: the voting-power reduction proper runs in
@@ -1208,8 +1269,8 @@ class DeviceBatchVerifier:
         if not idxs or len(proposal_hash) != 32:
             return out
         items = [
-            idxs[start : start + _BATCH_BUCKETS[-1]]
-            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+            idxs[start : start + self._dispatch_cap]
+            for start in range(0, len(idxs), self._dispatch_cap)
         ]
 
         def pack(chunk):
@@ -1220,7 +1281,7 @@ class DeviceBatchVerifier:
             )
 
         with trace.span(
-            "verify.drain", route="device", kind="seals", chunks=len(items)
+            "verify.drain", route=self._route, kind="seals", chunks=len(items)
         ):
             results = self._run_chunk_pipeline(items, pack, "verify_seals_ms")
             with trace.span("verify.quorum", route="mask"):
@@ -1251,18 +1312,21 @@ class DeviceBatchVerifier:
         if not idxs:
             return out
         items = [
-            idxs[start : start + _BATCH_BUCKETS[-1]]
-            for start in range(0, len(idxs), _BATCH_BUCKETS[-1])
+            idxs[start : start + self._dispatch_cap]
+            for start in range(0, len(idxs), self._dispatch_cap)
         ]
 
         def pack(chunk):
             with trace.span("verify.pack", kind="seal_lanes", lanes=len(chunk)):
-                inputs = pack_seal_lanes([lanes[i] for i in chunk])
+                inputs = pack_seal_lanes(
+                    [lanes[i] for i in chunk],
+                    pad_lanes=self._pad_lanes(len(chunk)),
+                )
             return chunk, inputs, self._table_dev(height)
 
         with trace.span(
             "verify.drain",
-            route="device",
+            route=self._route,
             kind="seal_lanes",
             chunks=len(items),
         ):
@@ -1292,7 +1356,7 @@ class DeviceBatchVerifier:
         """
         sender_mask = np.zeros(len(msgs), dtype=bool)
         seal_mask = np.zeros(len(seals), dtype=bool)
-        cap = _BATCH_BUCKETS[-1]
+        cap = self._dispatch_cap
         midx = [
             i for i, m in enumerate(msgs) if self._well_formed_sender(m, height)
         ]
@@ -1322,7 +1386,10 @@ class DeviceBatchVerifier:
             return item, inputs, self._table_dev(height)
 
         with trace.span(
-            "verify.drain", route="device", kind="round_chunked", chunks=len(items)
+            "verify.drain",
+            route=self._route,
+            kind="round_chunked",
+            chunks=len(items),
         ):
             results = self._run_chunk_pipeline(items, pack, "round_drain_ms")
             with trace.span("verify.quorum", route="mask"):
@@ -1335,13 +1402,25 @@ class DeviceBatchVerifier:
 QUARANTINED_LANES_KEY = ("go-ibft", "resilient", "quarantined_lanes")
 DRAIN_FAULTS_KEY = ("go-ibft", "resilient", "drain_faults")
 
+# Below this many lanes a sharded dispatch loses to one single-device
+# dispatch: the mesh pads every drain to ``bucket x dp`` lanes and pays a
+# multi-device launch, which only amortizes once the per-lane ladder work
+# dominates.  Default = half the largest single-device bucket (a drain
+# that nearly fills one device's biggest program is worth sharding);
+# callers with a measured crossover pass their own.
+MESH_CUTOVER_LANES = _BATCH_BUCKETS[-1] // 2
+
 
 class ResilientBatchVerifier:
     """Degraded-mode drain: quarantine poison lanes, demote dead rungs.
 
     Implements the :class:`~go_ibft_tpu.core.backend.BatchVerifier`
     protocol over a fastest-first ladder of rungs — by default
-    ``device -> host (native) -> pure Python`` — governed by a
+    ``device -> host (native) -> pure Python``, with an optional
+    ``mesh`` rung on top (lane-sharded drains; a mesh failure demotes to
+    single-device exactly like a device failure demotes to host, and
+    drains below ``mesh_cutover_lanes`` enter at the device rung
+    directly) — governed by a
     :class:`~go_ibft_tpu.verify.pipeline.CircuitBreaker`:
 
     * **Poison batches never propagate.**  A drain whose rung raises
@@ -1372,6 +1451,8 @@ class ResilientBatchVerifier:
         host: Optional[HostBatchVerifier] = None,
         python: Optional[HostBatchVerifier] = None,
         *,
+        mesh=None,
+        mesh_cutover_lanes: Optional[int] = None,
         validators_for_height: Optional[ValidatorSource] = None,
         breaker: Optional["CircuitBreaker"] = None,
     ):
@@ -1388,26 +1469,47 @@ class ResilientBatchVerifier:
                 validators_for_height or host._validators,
                 recover_fn=host_ecdsa.recover_pure,
             )
+        # ``mesh`` (a MeshBatchVerifier or compatible) prepends a fourth,
+        # fastest rung: a mesh fault demotes to single-device exactly like
+        # a device fault demotes to host.  Drains below the lane cutover
+        # enter at the device rung directly — sharding a handful of lanes
+        # pays padding + multi-device launch for nothing.
         self._rungs = [("device", device), ("host", host), ("python", python)]
+        self.mesh = mesh
+        if mesh is not None:
+            self._rungs.insert(0, ("mesh", mesh))
+        self.mesh_cutover = (
+            mesh_cutover_lanes
+            if mesh_cutover_lanes is not None
+            else MESH_CUTOVER_LANES
+        )
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             tuple(name for name, _ in self._rungs)
         )
         self.device = device
         self.host = host
 
-    # -- engine hooks (forwarded to the fast rung when it has them) ------
+    # -- engine hooks (forwarded to the fast rungs when they have them) --
+    # The mesh and device rungs each own a PackCache and table cache, so
+    # lifecycle hooks fan out to both.
+
+    def _fast_rungs(self):
+        return [self.device] if self.mesh is None else [self.mesh, self.device]
 
     def warmup(self, **kw) -> None:
-        if hasattr(self.device, "warmup"):
-            self.device.warmup(**kw)
+        for rung in self._fast_rungs():
+            if hasattr(rung, "warmup"):
+                rung.warmup(**kw)
 
     def note_round(self, round_: int) -> None:
-        if hasattr(self.device, "note_round"):
-            self.device.note_round(round_)
+        for rung in self._fast_rungs():
+            if hasattr(rung, "note_round"):
+                rung.note_round(round_)
 
     def reset_pack_cache(self) -> None:
-        if hasattr(self.device, "reset_pack_cache"):
-            self.device.reset_pack_cache()
+        for rung in self._fast_rungs():
+            if hasattr(rung, "reset_pack_cache"):
+                rung.reset_pack_cache()
 
     # -- BatchVerifier ---------------------------------------------------
 
@@ -1475,6 +1577,24 @@ class ResilientBatchVerifier:
         if n == 0:
             return out
         level, probe = self.breaker.acquire()
+        if self.mesh is not None and level == 0 and n < self.mesh_cutover:
+            # Lane-count cutover: small drains skip the mesh rung (padding
+            # to a dp multiple + a multi-device launch loses below it).  A
+            # pending mesh probe cannot be answered by a drain that will
+            # not run the mesh — release it so the next big drain gets it.
+            # KNOWN TRADE-OFF: faults recorded at this forced device level
+            # are no-ops while the breaker sits at the mesh level (the
+            # breaker counts consecutive faults at its ACTIVE level only),
+            # so a dead device rung under a healthy mesh never demotes for
+            # small drains — each one pays the exception + bisection to
+            # host, verdicts intact.  Accepted because the rungs share
+            # hardware: a faulting single-device dispatch with a HEALTHY
+            # mesh on the same devices is a corner (mesh faults demote 0->1
+            # first, after which device faults count normally); per-rung
+            # fault counters would be a CircuitBreaker redesign.
+            if probe:
+                self.breaker.abort_probe(level)
+            level = 1
         quarantined: List[int] = []
         faulted = [False]
         self._verify(level, list(range(n)), run, out, quarantined, faulted)
@@ -1485,8 +1605,11 @@ class ResilientBatchVerifier:
             self.breaker.record_success(level)
         if quarantined:
             metrics.inc_counter(QUARANTINED_LANES_KEY, len(quarantined))
-            if quarantinable is not None and hasattr(self.device, "quarantine"):
-                self.device.quarantine([quarantinable[i] for i in quarantined])
+            if quarantinable is not None:
+                condemned = [quarantinable[i] for i in quarantined]
+                for rung in self._fast_rungs():
+                    if hasattr(rung, "quarantine"):
+                        rung.quarantine(condemned)
         return out
 
     def _verify(self, level, idxs, run, out, quarantined, faulted) -> None:
@@ -1543,6 +1666,13 @@ class AdaptiveBatchVerifier:
     ints, mirroring ops/quorum.py ``power_reduce`` semantics (distinct
     validators counted once).
 
+    An optional ``mesh`` route (a
+    :class:`~go_ibft_tpu.verify.mesh_batch.MeshBatchVerifier`) adds a
+    second, upper lane-count cutover: drains at or above
+    ``mesh_cutover_lanes`` dispatch lane-sharded across the device mesh
+    first, with the single-device routes as their breaker-accounted
+    fallback (ladder ``mesh -> device -> host -> python``).
+
     Device-routed drains ride a :class:`ResilientBatchVerifier` ladder: a
     poison batch (device raising mid-dispatch, a lane whose packing blows
     up) is bisected/quarantined instead of crashing the drain, and the
@@ -1560,6 +1690,9 @@ class AdaptiveBatchVerifier:
         device: Optional[DeviceBatchVerifier] = None,
         host: Optional[HostBatchVerifier] = None,
         breaker: Optional[CircuitBreaker] = None,
+        *,
+        mesh=None,
+        mesh_cutover_lanes: Optional[int] = None,
     ):
         from ..utils import calibration
 
@@ -1575,23 +1708,37 @@ class AdaptiveBatchVerifier:
         self.cutover = cutover_lanes
         self.device = device if device is not None else DeviceBatchVerifier(validators_for_height)
         self.host = host if host is not None else HostBatchVerifier(validators_for_height)
+        # Optional mesh route (a MeshBatchVerifier): drains at or above
+        # ``mesh_cutover_lanes`` try the sharded rung first; the resilient
+        # ladder below becomes mesh -> device -> host -> python, so a mesh
+        # failure demotes to single-device before host.  Deliberately NOT
+        # auto-constructed — sharding is an explicit deployment decision
+        # (embedders/bench opt in), and a surprise shard_map compile must
+        # never land in a default engine.
+        self._mesh = mesh
         self._resilient = ResilientBatchVerifier(
             self.device,
             host=self.host,
+            mesh=mesh,
+            mesh_cutover_lanes=mesh_cutover_lanes,
             validators_for_height=validators_for_height,
             breaker=breaker,
         )
+        self.mesh_cutover = self._resilient.mesh_cutover
+        # The single-device rung's breaker level: 0 without a mesh, 1 with
+        # one (the mesh occupies level 0).
+        self._device_level = 0 if mesh is None else 1
         self.breaker = self._resilient.breaker
 
     def warmup(self, **kw) -> None:
-        self.device.warmup(**kw)
+        self._resilient.warmup(**kw)
 
     def note_round(self, round_: int) -> None:
-        """Engine hook: forward round advances to the device pack cache."""
-        self.device.note_round(round_)
+        """Engine hook: forward round advances to the fast-rung pack caches."""
+        self._resilient.note_round(round_)
 
     def reset_pack_cache(self) -> None:
-        self.device.reset_pack_cache()
+        self._resilient.reset_pack_cache()
 
     # -- host-side quorum (exact big ints) ------------------------------
 
@@ -1599,16 +1746,9 @@ class AdaptiveBatchVerifier:
         self, valid_addrs: Iterable[bytes], height: int, threshold: Optional[int]
     ) -> bool:
         with trace.span("verify.quorum", route="host-int"):
-            powers = self._validators(height)
-            thr = (
-                calculate_quorum(sum(powers.values()))
-                if threshold is None
-                else threshold
+            return host_quorum_reached(
+                self._validators, valid_addrs, height, threshold
             )
-            if thr <= 0:
-                return True
-            got = sum(powers.get(a, 0) for a in set(valid_addrs))
-            return got >= thr
 
     # -- BatchVerifier ---------------------------------------------------
 
@@ -1662,25 +1802,77 @@ class AdaptiveBatchVerifier:
         )
 
     def _breaker_gate(self) -> Tuple[bool, Optional[int]]:
-        """Consult the breaker before a fused device dispatch.
+        """Consult the breaker before a fused single-device dispatch.
 
         Returns ``(use_device, acquired_level)``: when the ladder is
-        demoted the fused dispatch is suppressed and the caller's
-        fallback serves the call.  An acquisition that does not end up
-        running the device MUST be released with
+        demoted below the device rung the fused dispatch is suppressed and
+        the caller's fallback serves the call.  An acquisition that does
+        not end up running the device MUST be released with
         ``breaker.abort_probe(acquired_level)`` once the call completes —
         never answered with success for a rung that did not run (the
         ladder would restore on no evidence), and a pending probe must
         not leak (``_probing`` would wedge and no probe would ever be
-        offered again)."""
-        level, _probe = self.breaker.acquire()
-        if level == 0:
+        offered again).  With a mesh rung present the device sits at
+        level 1; an active-or-probed mesh level is NOT consumable by a
+        single-device dispatch — a mesh probe stays pending through the
+        ladder fallback (same deferred-release discipline as a demoted
+        level), while a healthy mesh level simply lets the device run
+        without recording evidence against the mesh rung."""
+        level, probe = self.breaker.acquire()
+        if level == self._device_level:
+            # Plain dispatch at the device rung, or the device rung's own
+            # cooldown probe — either way success/fault at
+            # ``self._device_level`` is the correct answer.
+            return True, None
+        if level < self._device_level:
+            if probe:
+                return False, level
             return True, None
         return False, level
 
     def _device_faulted(self) -> None:
         metrics.inc_counter(("go-ibft", "resilient", "certify_fallback"))
-        self.breaker.record_fault(0)
+        self.breaker.record_fault(self._device_level)
+
+    def _mesh_gate(self, n: int) -> bool:
+        """Route a certify call to the sharded mesh rung?  True only when
+        a mesh exists, the drain clears the lane cutover, and the breaker
+        has not demoted the mesh."""
+        return self._mesh is not None and n >= self.mesh_cutover
+
+    def _try_mesh(self, n: int, call):
+        """One fused dispatch on the mesh rung, breaker-accounted.
+
+        Returns the call's result, or ``None`` when the mesh route was
+        unavailable (breaker demoted), faulted (recorded; the caller's
+        single-device/ladder fallback serves the drain), or the input was
+        poison (probe released; the ladder fallback quarantines)."""
+        if not self._mesh_gate(n):
+            return None
+        level, probe = self.breaker.acquire()
+        if level != 0:
+            if probe:
+                # A probe for a SLOWER rung (device/host) cannot be
+                # answered by a mesh dispatch that will not run: release
+                # it immediately — the cooldown has elapsed, so the very
+                # next gate (the single-device route below, or the
+                # resilient fallback) re-acquires and runs it with real
+                # evidence.
+                self.breaker.abort_probe(level)
+            return None
+        try:
+            result = call(self._mesh)
+        except MalformedLaneError:
+            # Input poison, not a mesh fault: release a pending probe and
+            # let the ladder-aware fallback quarantine the lane.
+            self.breaker.abort_probe(0)
+            return None
+        except Exception:  # noqa: BLE001 - demote mesh -> device
+            metrics.inc_counter(("go-ibft", "resilient", "certify_fallback"))
+            self.breaker.record_fault(0)
+            return None
+        self.breaker.record_success(0)
+        return result
 
     def _chunked_device(self, n: int, height: int) -> bool:
         # No supports_fused gate: the chunked route never touches the
@@ -1691,6 +1883,14 @@ class AdaptiveBatchVerifier:
     def certify_senders(
         self, msgs: Sequence[IbftMessage], height: int, threshold: Optional[int] = None
     ) -> Tuple[np.ndarray, bool]:
+        # Sharded route first: big drains go to the mesh rung (its quorum
+        # reduce runs on exact host ints, so it is exact for any power
+        # range); a mesh fault falls through to the single-device routes.
+        result = self._try_mesh(
+            len(msgs), lambda m: m.certify_senders(msgs, height, threshold)
+        )
+        if result is not None:
+            return result
         fallback_level = None
         device_route = self._route_device(len(msgs), height)
         if device_route:
@@ -1698,7 +1898,7 @@ class AdaptiveBatchVerifier:
             if use_device:
                 try:
                     result = self.device.certify_senders(msgs, height, threshold)
-                    self.breaker.record_success(0)
+                    self.breaker.record_success(self._device_level)
                     return result
                 except MalformedLaneError:
                     # Input poison, not a device fault: the rung is
@@ -1706,7 +1906,7 @@ class AdaptiveBatchVerifier:
                     # breaker fault — a pending probe is released, not
                     # failed, and the ladder-aware fallback below
                     # quarantines the lane.
-                    self.breaker.abort_probe(0)
+                    self.breaker.abort_probe(self._device_level)
                 except Exception:
                     # Device fault mid-phase: the fallback below still
                     # produces the verdict (no exception escapes a
@@ -1739,6 +1939,12 @@ class AdaptiveBatchVerifier:
         height: int,
         threshold: Optional[int] = None,
     ) -> Tuple[np.ndarray, bool]:
+        result = self._try_mesh(
+            len(seals),
+            lambda m: m.certify_seals(proposal_hash, seals, height, threshold),
+        )
+        if result is not None:
+            return result
         fallback_level = None
         device_route = self._route_device(len(seals), height)
         if device_route:
@@ -1748,10 +1954,10 @@ class AdaptiveBatchVerifier:
                     result = self.device.certify_seals(
                         proposal_hash, seals, height, threshold
                     )
-                    self.breaker.record_success(0)
+                    self.breaker.record_success(self._device_level)
                     return result
                 except MalformedLaneError:
-                    self.breaker.abort_probe(0)
+                    self.breaker.abort_probe(self._device_level)
                 except Exception:
                     self._device_faulted()
         if device_route or self._chunked_device(len(seals), height):
@@ -1773,6 +1979,15 @@ class AdaptiveBatchVerifier:
         height: int,
         prepare_threshold: Optional[int] = None,
     ) -> Tuple[np.ndarray, bool, np.ndarray, bool]:
+        if msgs and seals and len(proposal_hash) == 32:
+            result = self._try_mesh(
+                max(len(msgs), len(seals)),
+                lambda m: m.certify_round(
+                    msgs, proposal_hash, seals, height, prepare_threshold
+                ),
+            )
+            if result is not None:
+                return result
         fallback_level = None
         if (
             self._route_device(max(len(msgs), len(seals)), height)
@@ -1785,10 +2000,10 @@ class AdaptiveBatchVerifier:
                     result = self.device.certify_round(
                         msgs, proposal_hash, seals, height, prepare_threshold
                     )
-                    self.breaker.record_success(0)
+                    self.breaker.record_success(self._device_level)
                     return result
                 except MalformedLaneError:
-                    self.breaker.abort_probe(0)
+                    self.breaker.abort_probe(self._device_level)
                 except Exception:
                     # Fall through to the per-phase routes, which carry
                     # their own breaker accounting and ladder fallbacks.

@@ -1,0 +1,336 @@
+"""MeshBatchVerifier: the verify data plane sharded across the device mesh.
+
+Every production drain so far — engine quorum certification, the pipeline's
+double-buffered chunks, chain/sync seal verification — executed on ONE
+device, while ``parallel/mesh.py`` proved an 8-device shard_map
+quorum-certify program and nothing routed traffic through it.  This module
+closes that gap: :class:`MeshBatchVerifier` is a
+:class:`~go_ibft_tpu.verify.batch.DeviceBatchVerifier` whose dispatches
+place packed lanes across a ``(dp, vp)`` mesh, with
+
+* **lane-parallel sharding** — the lane axis is the data-parallel dim
+  (``in_specs=P("dp")`` per lane array, validator table replicated); each
+  device runs the UNCHANGED single-chip recovery ladder on its local lane
+  slice, so the sharded program stays a thin shell around the single-chip
+  one (the compile-budget pins enforce this per dp);
+* **masked dummy-lane padding** — lane counts pad to ``bucket x dp`` so
+  every shard gets an identical local shape; pad lanes are dead (``live``
+  False) end to end, so no dummy verdict can leak into a quorum count
+  (``tests/test_mesh_batch.py`` pins bit-identity to the sequential oracle
+  at uneven remainders);
+* **coalesced multi-drain dispatch** — the chunk capacity rises to
+  ``largest bucket x dp``, so a multi-height sync range (or several
+  chains' lanes) that used to cost dp sequential single-device dispatches
+  is ONE sharded launch, still riding the double-buffered
+  :class:`~go_ibft_tpu.verify.pipeline.VerifyPipeline`;
+* **host-side quorum reduce** — the certify entry points compute the
+  voting-power quorum from the sharded mask on exact host ints
+  (:func:`~go_ibft_tpu.verify.batch.host_quorum_reached`), keeping the
+  sharded program collective-free AND exact for any power range (no
+  ``supports_fused`` representability gate);
+* **transparent 1-device degradation** — when
+  :func:`~go_ibft_tpu.parallel.mesh.mesh_context` finds a single device
+  (or a dead backend) the instance behaves exactly as its
+  ``DeviceBatchVerifier`` base: no shard_map program is ever built, no
+  behavior changes.
+
+Sharding choices mirror the SNIPPETS.md compile-plan harness: the jit
+wrapper carries *explicit* ``in_shardings``/``out_shardings``
+(``NamedSharding`` per ``in_specs``) so array placement is stated, not
+inferred.  ``donate_argnums`` was re-evaluated for the sharded programs
+and stays REJECTED, per the PR-1/PR-2 analysis which holds per shard: XLA
+only aliases a donated input to an output of matching shape/dtype, and
+these programs map ``(B, 20)`` limb vectors to a ``(B,)`` boolean mask —
+nothing aliases, donation would emit a warning per compile and reuse
+nothing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..messages.helpers import CommittedSeal
+from ..messages.wire import IbftMessage
+from ..obs import trace
+from ..ops import quorum
+from ..ops import secp256k1 as sec
+from ..parallel.mesh import mesh_context, shard_map
+from ..utils import metrics
+from .batch import (
+    _BATCH_BUCKETS,
+    _bucket,
+    DeviceBatchVerifier,
+    ValidatorSource,
+    host_quorum_reached,
+)
+
+__all__ = ["MeshBatchVerifier", "mesh_verify_mask", "REDUCE_MS_KEY"]
+
+# Host-side quorum-reduce cost per sharded certify (the "reduce" leg the
+# bench evidence reports as reduce_ms).
+REDUCE_MS_KEY = ("go-ibft", "mesh", "reduce_ms")
+
+
+def _mask_fn(zw, r, s, v, claimed, table, live):
+    """Per-shard verification mask: the single-chip recovery ladder +
+    membership compare, identical to ``batch._recover_fn`` — kept
+    collective-free so the sharded program is embarrassingly parallel
+    (quorum reduction happens on host)."""
+    ok = quorum.sig_checks_zw(zw, r, s, v, claimed, live)
+    member = jnp.any(quorum.membership_eq(claimed, table), axis=-1)
+    return ok & member
+
+
+# One compiled sharded-mask program per mesh (tests and the bench share
+# meshes, so they share compiles; jit itself caches per input shape).
+_MASK_KERNELS: Dict[Mesh, object] = {}
+
+
+def mesh_verify_mask(mesh: Mesh):
+    """Build (or reuse) the lane-sharded verification-mask program.
+
+    ``shard_map`` over the mesh's ``dp`` axis: lane arrays shard on dim 0,
+    the validator table replicates, the mask comes back lane-sharded.  The
+    jit wrapper pins explicit ``in_shardings``/``out_shardings`` (the
+    SNIPPETS.md compile-plan posture) so host numpy inputs are placed
+    deterministically at the dispatch edge.
+    """
+    hit = _MASK_KERNELS.get(mesh)
+    if hit is not None:
+        return hit
+    lane = P("dp")
+    rep = P()
+    in_specs = (lane, lane, lane, lane, lane, rep, lane)
+    fn = shard_map(
+        _mask_fn, mesh=mesh, in_specs=in_specs, out_specs=lane, check_vma=False
+    )
+    kernel = jax.jit(
+        fn,
+        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+        out_shardings=NamedSharding(mesh, lane),
+        # donate_argnums deliberately empty: nothing aliases (see module
+        # docstring) — stated explicitly so the decision is visible at the
+        # compile plan, not implied by omission.
+        donate_argnums=(),
+    )
+    _MASK_KERNELS[mesh] = kernel
+    return kernel
+
+
+class MeshBatchVerifier(DeviceBatchVerifier):
+    """Lane-parallel sharded drain over the device mesh.
+
+    Drop-in wherever a :class:`DeviceBatchVerifier` goes: the
+    ``BatchVerifier`` protocol entry points (``verify_senders``,
+    ``verify_committed_seals``, ``verify_seal_lanes``,
+    ``verify_round_chunked``) inherit the parent's chunking/pipeline
+    machinery and only the dispatch seam changes; the fused certify entry
+    points compute their quorum on host ints from the sharded mask.
+
+    ``mesh`` wins when given; otherwise :func:`mesh_context` enumerates
+    devices (``dp``/``devices`` forwarded).  With one visible device the
+    instance IS a ``DeviceBatchVerifier`` in behavior — ``self.mesh`` is
+    ``None``, ``sharded`` False, and no shard_map program is built.
+    """
+
+    def __init__(
+        self,
+        validators_for_height: ValidatorSource,
+        *,
+        mesh: Optional[Mesh] = None,
+        dp: Optional[int] = None,
+        devices=None,
+        cache_heights: int = 4,
+    ):
+        super().__init__(validators_for_height, cache_heights=cache_heights)
+        if mesh is None:
+            mesh = mesh_context(dp, devices=devices)
+        if mesh is not None and mesh.devices.size < 2:
+            mesh = None
+        self.mesh = mesh
+        self.dp = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+        if mesh is not None:
+            self._mask_kernel = mesh_verify_mask(mesh)
+            self._dispatch_cap = _BATCH_BUCKETS[-1] * self.dp
+            self._route = "mesh"
+
+    @property
+    def sharded(self) -> bool:
+        return self.mesh is not None
+
+    # -- pad/placement seams --------------------------------------------
+
+    def _pad_lanes(self, n: int) -> int:
+        """Smallest ``bucket x dp`` lane count holding ``n`` lanes.
+
+        The per-shard shape is the bucket of ``ceil(n / dp)``, so the
+        local program compiles at the same lane buckets as the
+        single-device kernels; the global pad lanes are dead (``live``
+        False) and their verdicts are sliced off before any caller sees
+        them."""
+        if self.mesh is None or n == 0:
+            return 0
+        return _bucket((n + self.dp - 1) // self.dp, _BATCH_BUCKETS) * self.dp
+
+    def _table_dev(self, height: int) -> jnp.ndarray:
+        """Validator table replicated across the mesh (uploaded once per
+        height, like the parent's single-device pin)."""
+        if self.mesh is None:
+            return super()._table_dev(height)
+        hit = self._tables_dev.get(height)
+        if hit is None:
+            hit = jax.device_put(
+                self._table(height), NamedSharding(self.mesh, P())
+            )
+            self._tables_dev[height] = hit
+        return hit
+
+    # -- dispatch -------------------------------------------------------
+
+    def _dispatch_async(self, inputs, table, quorum_args):
+        """Queue one sharded mask dispatch (mask-only route).
+
+        The fused single-device kernels (``quorum_args`` set) never run
+        here — the certify overrides below reduce quorum on host — but the
+        seam stays delegating for safety if a caller reaches it.
+        """
+        if self.mesh is None or quorum_args is not None:
+            return super()._dispatch_async(inputs, table, quorum_args)
+        zw, r, s, v, claimed, live = inputs
+        lanes = int(np.shape(live)[0])
+        with trace.span(
+            "verify.shard",
+            devices=self.dp,
+            lanes=lanes,
+            lanes_per_device=lanes // self.dp,
+        ):
+            with trace.span("verify.dispatch", route="mesh"):
+                mask = self._mask_kernel(
+                    jnp.asarray(zw),
+                    jnp.asarray(r),
+                    jnp.asarray(s),
+                    jnp.asarray(v),
+                    jnp.asarray(claimed),
+                    table,
+                    jnp.asarray(live),
+                )
+        return mask, None
+
+    def warmup(
+        self,
+        lanes: Sequence[int] = (8,),
+        blocks: Sequence[int] = (2, 8),
+        table_rows: int = 8,
+    ) -> None:
+        """Pre-compile the single-device kernels AND the sharded mask
+        program at ``bucket x dp`` global shapes (a consensus engine must
+        never stall mid-round on a shard_map compile)."""
+        super().warmup(lanes=lanes, blocks=blocks, table_rows=table_rows)
+        if self.mesh is None:
+            return
+        nl = sec.FIELD.nlimbs
+        for bb in lanes:
+            g = _bucket(bb, _BATCH_BUCKETS) * self.dp
+            self._mask_kernel(
+                jnp.zeros((g, 8), jnp.uint32),
+                jnp.zeros((g, nl), jnp.int32),
+                jnp.zeros((g, nl), jnp.int32),
+                jnp.zeros((g,), jnp.int32),
+                jnp.zeros((g, 5), jnp.uint32),
+                jax.device_put(
+                    np.zeros((table_rows, 5), np.uint32),
+                    NamedSharding(self.mesh, P()),
+                ),
+                jnp.zeros((g,), bool),
+            ).block_until_ready()
+
+    # -- fused certify: sharded mask + host-int quorum reduce ------------
+
+    def supports_fused(self, height: int) -> bool:
+        """Always true on the sharded route: the quorum reduction runs on
+        exact host ints, so there is no device-representability gate."""
+        if self.mesh is None:
+            return super().supports_fused(height)
+        return True
+
+    def _reduce(
+        self, valid_addrs, height: int, threshold: Optional[int]
+    ) -> bool:
+        t0 = time.perf_counter()
+        with trace.span("verify.quorum", route="host-int", shard="reduce"):
+            reached = host_quorum_reached(
+                self._validators, valid_addrs, height, threshold
+            )
+        metrics.observe(REDUCE_MS_KEY, (time.perf_counter() - t0) * 1e3)
+        return reached
+
+    def certify_senders(
+        self,
+        msgs: Sequence[IbftMessage],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool]:
+        if self.mesh is None:
+            return super().certify_senders(msgs, height, threshold)
+        out = np.zeros(len(msgs), dtype=bool)
+        idxs = [
+            i for i, m in enumerate(msgs) if self._well_formed_sender(m, height)
+        ]
+        if not idxs:
+            return out, self._reduce((), height, threshold)
+        sub = [msgs[i] for i in idxs]
+        mask = self.verify_senders(sub)
+        out[np.asarray(idxs)] = mask[: len(idxs)]
+        reached = self._reduce(
+            [m.sender for m, ok in zip(sub, mask) if ok], height, threshold
+        )
+        return out, reached
+
+    def certify_seals(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool]:
+        if self.mesh is None:
+            return super().certify_seals(proposal_hash, seals, height, threshold)
+        mask = self.verify_committed_seals(proposal_hash, seals, height)
+        reached = self._reduce(
+            [s.signer for s, ok in zip(seals, mask) if ok], height, threshold
+        )
+        return mask, reached
+
+    def certify_round(
+        self,
+        msgs: Sequence[IbftMessage],
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        prepare_threshold: Optional[int] = None,
+    ) -> Tuple[np.ndarray, bool, np.ndarray, bool]:
+        if self.mesh is None:
+            return super().certify_round(
+                msgs, proposal_hash, seals, height, prepare_threshold
+            )
+        # Both phases drain through ONE pipeline of sharded dispatches
+        # (seal packing overlaps the tail envelope dispatches); each
+        # phase's quorum reduces on host ints.
+        sender_mask, seal_mask = self.verify_round_chunked(
+            msgs, proposal_hash, seals, height
+        )
+        p_ok = self._reduce(
+            [m.sender for m, ok in zip(msgs, sender_mask) if ok],
+            height,
+            prepare_threshold,
+        )
+        s_ok = self._reduce(
+            [s.signer for s, ok in zip(seals, seal_mask) if ok], height, None
+        )
+        return sender_mask, p_ok, seal_mask, s_ok

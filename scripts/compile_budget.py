@@ -25,14 +25,16 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# The shard_map mesh entry needs >= 2 virtual devices before the backend
-# initializes (same trick as tests/conftest.py, sized minimally: the
-# budget tracks the per-shard program, whose trace is device-count
-# independent — 2 is the smallest real (dp, vp) = (2, 1) mesh).
+# The shard_map mesh entries need virtual devices before the backend
+# initializes (same trick as tests/conftest.py).  8 covers the per-dp
+# budget sweep (dp = 2/4/8): the sharded program must stay a THIN SHELL
+# around the single-chip one at EVERY dp — SPMD propagation or a
+# collective regression that re-traces the EC ladder per shard shows up
+# as per-dp line growth here first.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=2"
+        _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
 SNAPSHOT = pathlib.Path(__file__).resolve().parent.parent / "docs" / "compile_budget.json"
@@ -46,6 +48,7 @@ def _programs() -> dict:
 
     from go_ibft_tpu.ops import quorum, secp256k1 as sec
     from go_ibft_tpu.parallel import make_mesh, mesh_quorum_certify
+    from go_ibft_tpu.verify.mesh_batch import mesh_verify_mask
 
     L = sec.FIELD.nlimbs
     B = 8  # the engine-route lane bucket (the acceptance-tracked compile)
@@ -63,22 +66,23 @@ def _programs() -> dict:
     def lines(fn, *args) -> int:
         return len(jax.jit(fn).lower(*args).as_text().splitlines())
 
-    # The multi-chip program: shard_map over a (dp=2, vp=1) mesh at the
-    # same 8-lane engine shape.  Tracks that the sharded wrapper stays a
-    # thin shell around the single-chip program — SPMD propagation or a
-    # collective regression that re-traces the EC ladder per shard shows
-    # up as line growth here first (VERDICT item 5, first step).
-    mesh = make_mesh(2, devices=jax.devices("cpu")[:2])
-    mesh_fn = mesh_quorum_certify(mesh)
-
-    return {
+    # The multi-chip programs: shard_map meshes at dp = 2/4/8.  Two
+    # program families are pinned per dp:
+    #
+    # * ``mesh_quorum_certify`` — the fused quorum-certify dryrun program
+    #   (8 GLOBAL lanes, matching the original dp=2 pin so the 27,370-line
+    #   mark stays comparable);
+    # * ``mesh_verify_mask`` — the MeshBatchVerifier production drain
+    #   program, lowered at 8 LOCAL lanes per shard (global = 8 x dp) so
+    #   every dp pins the same per-shard shape and the per-dp delta
+    #   isolates the shard_map wrapper itself.
+    #
+    # Both must stay thin shells around the single-chip program — SPMD
+    # propagation or a collective regression that re-traces the EC ladder
+    # per shard shows up as per-dp line growth here first.
+    out = {
         "quorum_certify_8l": lines(
             quorum.quorum_certify,
-            blocks, counts, limbs, limbs, v, addr, table, live, power, power,
-            thr, thr,
-        ),
-        "mesh_quorum_certify_8l_dp2": lines(
-            mesh_fn,
             blocks, counts, limbs, limbs, v, addr, table, live, power, power,
             thr, thr,
         ),
@@ -91,6 +95,30 @@ def _programs() -> dict:
         "ecdsa_recover_8l": lines(sec.ecdsa_recover, limbs, limbs, limbs, v),
         "ecmul2_base_8l": lines(sec.ecmul2_base, limbs, limbs, limbs, limbs),
     }
+    cpu = jax.devices("cpu")
+    for dp in (2, 4, 8):
+        mesh = make_mesh(dp, devices=cpu[:dp])
+        out[f"mesh_quorum_certify_8l_dp{dp}"] = lines(
+            mesh_quorum_certify(mesh),
+            blocks, counts, limbs, limbs, v, addr, table, live, power, power,
+            thr, thr,
+        )
+        g = B * dp  # 8 local lanes per shard
+        out[f"mesh_verify_mask_8l_dp{dp}"] = len(
+            mesh_verify_mask(mesh)
+            .lower(
+                jnp.zeros((g, 8), jnp.uint32),
+                jnp.zeros((g, L), jnp.int32),
+                jnp.zeros((g, L), jnp.int32),
+                jnp.zeros((g,), jnp.int32),
+                jnp.zeros((g, 5), jnp.uint32),
+                jnp.zeros((8, 5), jnp.uint32),
+                jnp.zeros((g,), bool),
+            )
+            .as_text()
+            .splitlines()
+        )
+    return out
 
 
 def main() -> int:

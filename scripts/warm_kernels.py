@@ -76,6 +76,25 @@ def main() -> None:
         dryrun_multichip(8)
         _stamp("mesh dryrun programs (8-device (dp, vp))", t0)
 
+        # MeshBatchVerifier's sharded mask program at the tier-1 test
+        # shapes (dp=2 and dp=8, 8 local lanes, 8-row table): the oracle-
+        # parity suite dispatches exactly these, and a cold shard_map
+        # compile inside a test timeout is the same failure mode as the
+        # dryrun's.
+        import jax
+
+        from go_ibft_tpu.parallel import mesh_context
+        from go_ibft_tpu.verify import MeshBatchVerifier
+
+        for dp in (2, 8):
+            t0 = time.perf_counter()
+            mv = MeshBatchVerifier(
+                lambda h: {}, mesh=mesh_context(dp, devices=jax.devices()[:dp])
+            )
+            if mv.sharded:
+                mv.warmup()
+                _stamp(f"MeshBatchVerifier mask program (dp={dp})", t0)
+
     t0 = time.perf_counter()
     DeviceBatchVerifier(lambda h: {}).warmup()
     _stamp("DeviceBatchVerifier buckets", t0)

@@ -1,7 +1,8 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
-	warm cluster-bench obs-report chain-soak mesh-bench compile-budget
+	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
+	ab-keccak
 
 test:
 	python -m pytest tests/ -q
@@ -44,6 +45,12 @@ mesh-bench:
 # dp=2/4/8 (trace size IS cold-compile time on XLA:CPU)
 compile-budget:
 	python scripts/compile_budget.py
+
+# Pallas keccak A/B in CI's forced-host mode: interpret-mode execution +
+# bit-exact parity vs the XLA route (skips with reason when Pallas is
+# unavailable on the pinned jax); real perf numbers need a live TPU.
+ab-keccak:
+	python scripts/ab_keccak.py --cpu --sizes 8,64 --reps 3
 
 # Regression gates: fresh bench evidence (bench_evidence.jsonl) vs the
 # best prior BENCH_r*.json on the same backend (go_ibft_tpu/obs/gates.py)

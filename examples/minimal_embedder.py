@@ -40,13 +40,19 @@ class StdoutLogger:
 
 
 def build_cluster(
-    n: int, use_device: bool, use_bls: bool = False, use_mesh: bool = False
+    n: int,
+    use_device: bool,
+    use_bls: bool = False,
+    use_mesh: bool = False,
+    use_aggregate: bool = False,
 ):
     # 1. Validator identities and the (static) voting-power map.
     keys = [PrivateKey.from_seed(b"example-validator-%d" % i) for i in range(n)]
     powers = {k.address: 1 for k in keys}
     validators = ECDSABackend.static_validators(powers)
 
+    use_bls = use_bls or use_aggregate
+    certifier = hub = None
     if use_bls:
         # BLS committed seals: ECDSA envelopes + BLS G2 seals, so a whole
         # COMMIT quorum certifies with ONE pairing (aggregate verification).
@@ -56,13 +62,33 @@ def build_cluster(
         bls_keys = [
             hbls.BLSPrivateKey.from_seed(b"example-bls-%d" % i) for i in range(n)
         ]
-        pubkeys = {
-            k.address: bk.pubkey for k, bk in zip(keys, bls_keys)
-        }
-        bls_src = ECDSABackend.static_validators(pubkeys)  # same snapshot shape
+        if use_aggregate:
+            # Production posture: pubkeys enter the aggregation set ONLY
+            # with a proof of possession (rogue-key defense), and COMMIT
+            # dissemination rides the aggregation tree — seals merge
+            # upward as partial aggregates, one O(1) quorum certificate
+            # broadcasts down, every node finalizes with ONE pairing.
+            from go_ibft_tpu.crypto.quorum_cert import (
+                BLSCertifier,
+                BLSKeyRegistry,
+            )
+            from go_ibft_tpu.net import AggregationTreeGossip
 
-    # 2. One engine per validator, all wired to one loopback "network".
-    transport = LoopbackTransport()
+            registry = BLSKeyRegistry()
+            for k, bk in zip(keys, bls_keys):
+                registry.register_key(k.address, bk)
+            bls_src = registry
+            certifier = BLSCertifier(validators, registry)
+            hub = AggregationTreeGossip(certifier, fan_in=2)
+        else:
+            pubkeys = {
+                k.address: bk.pubkey for k, bk in zip(keys, bls_keys)
+            }
+            bls_src = ECDSABackend.static_validators(pubkeys)
+
+    # 2. One engine per validator, all wired to one loopback "network"
+    # (or the aggregation tree in --aggregate mode).
+    transport = LoopbackTransport() if hub is None else None
     engines = []
     for i, key in enumerate(keys):
         build = lambda view: b"example block %d" % view.height  # noqa: E731
@@ -113,12 +139,21 @@ def build_cluster(
                     batch_verifier, BLSAggregateVerifier(bls_src)
                 )
         engine = IBFT(
-            StdoutLogger(), backend, transport, batch_verifier=batch_verifier
+            StdoutLogger(),
+            backend,
+            transport,
+            batch_verifier=batch_verifier,
+            cert_verifier=certifier,
         )
         engine.set_base_round_timeout(10.0)
-        transport.register(engine.add_message)
+        if hub is not None:
+            engine.transport = hub.register(
+                key.address, engine.add_message, engine.add_quorum_certificate
+            )
+        else:
+            transport.register(engine.add_message)
         engines.append(engine)
-    return engines
+    return engines, certifier, hub
 
 
 async def main_async(
@@ -127,18 +162,31 @@ async def main_async(
     use_device: bool,
     use_bls: bool = False,
     use_mesh: bool = False,
+    use_aggregate: bool = False,
 ) -> None:
-    engines = build_cluster(n, use_device, use_bls, use_mesh)
+    engines, _certifier, hub = build_cluster(
+        n, use_device, use_bls, use_mesh, use_aggregate
+    )
+    if hub is not None:
+        hub.start()
     try:
         for h in range(1, heights + 1):
             # Every validator runs the height concurrently; run_sequence
             # returns once the proposal is finalized on that node.
             await asyncio.gather(*(e.run_sequence(h) for e in engines))
     finally:
+        if hub is not None:
+            await hub.stop()
         for e in engines:
             e.messages.close()
 
     _print_chains(engines)
+    if hub is not None:
+        stats = hub.stats()
+        print(
+            f"aggregation tree: {stats['certs_built']} certs, worst node "
+            f"sent {max(stats['commit_bytes_per_node'])} commit bytes"
+        )
 
 
 async def main_chain(
@@ -147,6 +195,7 @@ async def main_chain(
     use_device: bool,
     use_bls: bool = False,
     use_mesh: bool = False,
+    use_aggregate: bool = False,
 ) -> None:
     """The continuous-node mode: one ChainRunner per validator.
 
@@ -170,7 +219,9 @@ async def main_chain(
     )
     from go_ibft_tpu.verify import HostBatchVerifier
 
-    engines = build_cluster(n, use_device, use_bls, use_mesh)
+    engines, certifier, hub = build_cluster(
+        n, use_device, use_bls, use_mesh, use_aggregate
+    )
     network = LoopbackSyncNetwork()
     runners = []
     with tempfile.TemporaryDirectory() as tmp:
@@ -179,20 +230,26 @@ async def main_chain(
             runner = ChainRunner(
                 engine,
                 WriteAheadLog(os.path.join(tmp, f"wal-{i}.jsonl")),
+                certifier=certifier,
                 sync=SyncClient(
                     engine.backend.id(),
                     network,
                     engine.batch_verifier or HostBatchVerifier(src),
                     src,
+                    cert_verifier=certifier,
                 ),
             )
             network.register(engine.backend.id(), runner)
             runners.append(runner)
+        if hub is not None:
+            hub.start()
         try:
             await asyncio.gather(
                 *(r.run(until_height=heights) for r in runners)
             )
         finally:
+            if hub is not None:
+                await hub.stop()
             for engine in engines:
                 engine.messages.close()
         for i, runner in enumerate(runners):
@@ -209,8 +266,15 @@ async def main_chain(
 def _print_chains(engines) -> None:
     for i, e in enumerate(engines):
         chain = [p.raw_proposal.decode() for p, _seals in e.backend.inserted]
-        seals = len(e.backend.inserted[-1][1])
-        print(f"validator {i}: chain={chain} (last block carries {seals} seals)")
+        _p, last_seals = e.backend.inserted[-1]
+        if e.finalized_certificate is not None:
+            evidence = (
+                f"one {len(e.finalized_certificate.encode())}-byte "
+                "aggregate certificate"
+            )
+        else:
+            evidence = f"{len(last_seals)} seals"
+        print(f"validator {i}: chain={chain} (last block carries {evidence})")
 
 
 if __name__ == "__main__":
@@ -235,6 +299,14 @@ if __name__ == "__main__":
         help="BLS12-381 committed seals (one pairing certifies a quorum)",
     )
     ap.add_argument(
+        "--aggregate",
+        action="store_true",
+        help="the full aggregate-COMMIT mode (implies --bls): PoP-gated "
+        "key registry, aggregation-tree COMMIT dissemination, engines "
+        "finalize from one O(1) quorum certificate, and (--chain) the "
+        "WAL/sync carry certificates instead of per-validator seals",
+    )
+    ap.add_argument(
         "--chain",
         action="store_true",
         help="drive heights through ChainRunners (persistent per-node "
@@ -244,5 +316,12 @@ if __name__ == "__main__":
     args = ap.parse_args()
     runner = main_chain if args.chain else main_async
     asyncio.run(
-        runner(args.nodes, args.heights, args.device, args.bls, args.mesh)
+        runner(
+            args.nodes,
+            args.heights,
+            args.device,
+            args.bls,
+            args.mesh,
+            args.aggregate,
+        )
     )

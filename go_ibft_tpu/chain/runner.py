@@ -72,6 +72,7 @@ class ChainRunner:
         wal: Optional[WriteAheadLog] = None,
         *,
         sync: Optional[SyncClient] = None,
+        certifier=None,
         overlap: bool = True,
         overlap_poll_s: float = 0.002,
         max_chain_blocks: int = 8192,
@@ -82,6 +83,15 @@ class ChainRunner:
         self.engine = engine
         self.wal = wal
         self.sync = sync
+        # Aggregate-COMMIT compression (ISSUE 7): a certifier (a
+        # :class:`~go_ibft_tpu.crypto.quorum_cert.BLSCertifier`) makes
+        # every finalize record O(1) — a height the engine finalized from
+        # an aggregate certificate persists that certificate verbatim;
+        # one finalized per-seal is compressed into a certificate at
+        # persist time (no pairing: the seals were verified when the
+        # quorum formed).  Peers then serve certificate blocks and the
+        # sync client re-verifies each height with ONE pairing.
+        self.certifier = certifier
         self.overlap = overlap
         self._overlap_poll_s = overlap_poll_s
         self._sync_poll_s = sync_poll_s
@@ -163,9 +173,42 @@ class ChainRunner:
     def _on_finalize(
         self, height: int, proposal: Proposal, seals: List[CommittedSeal]
     ) -> None:
+        # Prefer the certificate that actually finalized the height
+        # (tree-gossip mode) — REGARDLESS of whether this runner carries a
+        # certifier: a cert-finalized height's seal list is the synthetic
+        # AGG_CERT_SIGNER sentinel, and persisting/serving that as a real
+        # seal would hand peers a block their seal-lane verify can never
+        # accept.  Persisting the cert itself needs no certifier.
+        cert = getattr(self.engine, "finalized_certificate", None)
+        if self.certifier is not None:
+            # Otherwise compress the verified seal quorum into one.  A
+            # failed build (e.g. ECDSA seals a BLS certifier cannot
+            # decode) falls back to per-seal evidence — never a lossy
+            # record.
+            if cert is None:
+                try:
+                    from ..crypto.backend import proposal_hash_of
+
+                    cert = self.certifier.build(
+                        height, proposal.round, proposal_hash_of(proposal), seals
+                    )
+                except Exception as err:  # noqa: BLE001 - keep per-seal
+                    # evidence, but SAY so: a persistently mis-wired
+                    # certifier silently producing O(N) records forever
+                    # is an operations bug nobody would otherwise see.
+                    self.engine.log.error(
+                        "certifier failed; falling back to per-seal "
+                        "finalize record",
+                        height,
+                        err,
+                    )
+                    cert = None
+        stored_seals = [] if cert is not None else list(seals)
         if self.wal is not None:
-            self.wal.append_finalize(height, proposal, seals)
-        self._append_block(FinalizedBlock(height, proposal, list(seals)))
+            self.wal.append_finalize(height, proposal, stored_seals, cert=cert)
+        self._append_block(
+            FinalizedBlock(height, proposal, stored_seals, cert=cert)
+        )
 
     def _on_lock(
         self,
@@ -512,7 +555,7 @@ class ChainRunner:
             self.engine.backend.insert_proposal(block.proposal, block.seals)
             if self.wal is not None:
                 self.wal.append_finalize(
-                    block.height, block.proposal, block.seals
+                    block.height, block.proposal, block.seals, cert=block.cert
                 )
             self._append_block(block)
         if blocks:

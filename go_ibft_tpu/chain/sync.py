@@ -54,10 +54,12 @@ __all__ = [
     "SyncSource",
     "SYNCED_HEIGHTS_KEY",
     "SYNC_DRAINS_KEY",
+    "SYNC_CERT_HEIGHTS_KEY",
 ]
 
 SYNCED_HEIGHTS_KEY = ("go-ibft", "chain", "synced_heights")
 SYNC_DRAINS_KEY = ("go-ibft", "chain", "sync_drains")
+SYNC_CERT_HEIGHTS_KEY = ("go-ibft", "chain", "sync_cert_heights")
 
 
 class SyncError(RuntimeError):
@@ -121,12 +123,19 @@ class SyncClient:
         verifier,
         validators_for_height: Callable[[int], Mapping[bytes, int]],
         *,
+        cert_verifier=None,
         max_batch_heights: int = 4096,
     ) -> None:
         self.node_id = node_id
         self.network = network
         self.verifier = verifier
         self._validators = validators_for_height
+        # Aggregate-certificate route (ISSUE 7): blocks served with an
+        # AggregateQuorumCertificate instead of per-validator seals verify
+        # through this (a BLSCertifier or compatible) — ONE pairing
+        # equation per height-range entry, quorum power from the signer
+        # bitmap — instead of N seal lanes through ``verifier``.
+        self.cert_verifier = cert_verifier
         self.max_batch_heights = max_batch_heights
 
     # -- peer observation ----------------------------------------------
@@ -174,16 +183,31 @@ class SyncClient:
         return blocks
 
     def verify_blocks(self, blocks: Sequence[FinalizedBlock]) -> None:
-        """Verify every committed seal of ``blocks`` in batched drains.
+        """Verify every fetched block's commit evidence.
 
-        One ``verify_seal_lanes`` drain per validator-set snapshot — with
-        a static validator set (the common case) the WHOLE height range is
+        Blocks carrying an aggregate quorum certificate verify on the
+        O(1) route: one pairing equation per height-range entry (the
+        certificate's proposal hash must match the block's proposal, the
+        signer bitmap must reach quorum power — both checked inside the
+        cert verifier — so a peer can never relabel a certificate onto a
+        different proposal).  Requires ``cert_verifier``; a cert-carrying
+        block without one is a :class:`SyncError`, never silently trusted.
+
+        Seal-carrying blocks keep the batched lane route: one
+        ``verify_seal_lanes`` drain per validator-set snapshot — with a
+        static validator set (the common case) the WHOLE height range is
         a single drain.  Grouping by snapshot keeps the device's
         one-table-per-drain shape exactly as honest as the sequential
         oracle: every lane in a drain shares the validator set its own
         height would select.  After the mask comes back, each height's
         valid signers must reach that height's voting-power quorum.
         """
+        cert_blocks = [b for b in blocks if b.cert is not None]
+        if cert_blocks:
+            self._verify_cert_blocks(cert_blocks)
+        blocks = [b for b in blocks if b.cert is None]
+        if not blocks:
+            return
         groups: Dict[tuple, List[int]] = {}
         snapshots: List[Mapping[bytes, int]] = []
         heights: List[int] = []
@@ -245,3 +269,41 @@ class SyncClient:
                     f"quorum {quorum} ({int(mask.sum())}/{len(block.seals)} "
                     "seals valid)"
                 )
+
+    def _verify_cert_blocks(self, blocks: Sequence[FinalizedBlock]) -> None:
+        """O(1)-per-height verification of certificate-carrying blocks."""
+        if self.cert_verifier is None:
+            raise SyncError(
+                "peer served aggregate-certificate blocks but this client "
+                "has no cert_verifier to check them"
+            )
+        with trace.span(
+            "chain.sync.cert_verify", heights=len(blocks)
+        ):
+            for block in blocks:
+                cert = block.cert
+                if block.seals:
+                    # A cert block carries NO per-validator seals (the WAL
+                    # writes them mutually exclusively); a peer serving
+                    # both is smuggling seals past verification — this
+                    # path checks only the certificate, and the runner
+                    # would otherwise insert and re-serve the unchecked
+                    # seal list as commit evidence.
+                    raise SyncError(
+                        f"height {block.height}: certificate block "
+                        "carries a seal list (unverifiable evidence mix)"
+                    )
+                if (
+                    cert.height != block.height
+                    or cert.proposal_hash != proposal_hash_of(block.proposal)
+                ):
+                    raise SyncError(
+                        f"height {block.height}: certificate does not bind "
+                        "the served proposal"
+                    )
+                if not self.cert_verifier.verify(cert):
+                    raise SyncError(
+                        f"height {block.height}: aggregate quorum "
+                        "certificate failed verification"
+                    )
+                metrics.inc_counter(SYNC_CERT_HEIGHTS_KEY)

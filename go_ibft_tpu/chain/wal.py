@@ -57,11 +57,24 @@ class WalCorruptionError(ValueError):
 
 @dataclass
 class FinalizedBlock:
-    """One durable chain entry: what ``InsertProposal`` received."""
+    """One durable chain entry: what ``InsertProposal`` received.
+
+    ``cert`` (an :class:`~go_ibft_tpu.crypto.quorum_cert.
+    AggregateQuorumCertificate`) is the O(1) alternative to ``seals``: a
+    block finalized — or compressed at persist time — under the
+    aggregate-COMMIT mode carries ONE aggregated G2 seal plus a signer
+    bitmap instead of N individual seals, and every consumer (WAL replay,
+    block-sync verification) re-checks it with ONE pairing equation.
+    The two evidence forms are mutually exclusive: ``append_finalize``
+    writes an empty seal list whenever a certificate rides, and the sync
+    client REJECTS a peer-served block carrying both (a seal list next to
+    a certificate would bypass seal verification entirely).
+    """
 
     height: int
     proposal: Proposal
     seals: List[CommittedSeal] = field(default_factory=list)
+    cert: Optional[object] = None
 
 
 @dataclass
@@ -144,20 +157,33 @@ class WriteAheadLog:
                 os.fsync(fh.fileno())
 
     def append_finalize(
-        self, height: int, proposal: Proposal, seals: List[CommittedSeal]
+        self,
+        height: int,
+        proposal: Proposal,
+        seals: List[CommittedSeal],
+        cert=None,
     ) -> None:
-        """Durably record one finalized height (fsync before returning)."""
-        self._append(
-            {
-                "kind": "finalize",
-                "height": height,
-                "proposal": proposal.encode().hex(),
-                "seals": [
-                    [s.signer.hex(), s.signature.hex()] for s in seals
-                ],
-            },
-            fsync=True,
+        """Durably record one finalized height (fsync before returning).
+
+        ``cert`` (an AggregateQuorumCertificate) replaces the per-seal
+        list on disk: the finalize record becomes O(1) in committee size
+        — 240 bytes + 1 bitmap bit per validator instead of one 192-byte
+        seal each — and replay hands the certificate back for one-pairing
+        re-verification instead of N seal lanes.
+        """
+        record = {
+            "kind": "finalize",
+            "height": height,
+            "proposal": proposal.encode().hex(),
+        }
+        if cert is not None:
+            record["cert"] = cert.encode().hex()
+        record["seals"] = (
+            []
+            if cert is not None
+            else [[s.signer.hex(), s.signature.hex()] for s in seals]
         )
+        self._append(record, fsync=True)
 
     def append_lock(
         self, height: int, round_: int, certificate: Optional[PreparedCertificate]
@@ -179,6 +205,16 @@ class WriteAheadLog:
     def _parse(record: dict):
         kind = record["kind"]
         if kind == "finalize":
+            cert_hex = record.get("cert")
+            cert = None
+            if cert_hex is not None:
+                # Lazy import: the certificate codec pulls the BLS stack,
+                # which plain ECDSA-seal WALs never need.
+                from ..crypto.quorum_cert import AggregateQuorumCertificate
+
+                cert = AggregateQuorumCertificate.decode(
+                    bytes.fromhex(cert_hex)
+                )
             return FinalizedBlock(
                 height=int(record["height"]),
                 proposal=Proposal.decode(bytes.fromhex(record["proposal"])),
@@ -189,6 +225,7 @@ class WriteAheadLog:
                     )
                     for signer, signature in record.get("seals", ())
                 ],
+                cert=cert,
             )
         if kind == "lock":
             pc_hex = record.get("pc")

@@ -143,6 +143,7 @@ class IBFT:
         *,
         message_store: Optional[MessageStore] = None,
         batch_verifier: Optional[BatchVerifier] = None,
+        cert_verifier=None,
     ) -> None:
         self.log = logger
         self.backend = backend
@@ -208,6 +209,24 @@ class IBFT:
         # height H while catching up can neither run H nor, if it is H+1's
         # proposer, let anyone else proceed).
         self.future_proposal_horizon = 4
+        # Aggregate-COMMIT path (ISSUE 7): ``cert_verifier`` (a
+        # BLSCertifier or compatible — one ``verify(cert)`` doing ONE
+        # pairing equation + exact quorum power over the cert's signer
+        # bitmap) enables finalizing a height straight from an
+        # AggregateQuorumCertificate delivered by the aggregation-tree
+        # gossip transport (net/aggtree.py) — the engine never needs a
+        # quorum of INDIVIDUAL COMMITs when a verified certificate proves
+        # one existed.  Certificates land in a tiny pending slot keyed by
+        # height (latest wins; one live height + one ahead, same bounded
+        # posture as the future-message buffer) and are consumed by the
+        # COMMIT drain on the event loop, where the accepted proposal is
+        # stable.  ``finalized_certificate`` records the cert that
+        # finalized the CURRENT height (None for per-seal finalization);
+        # the chain runner persists it as the height's O(1) WAL record.
+        self.cert_verifier = cert_verifier
+        self._cert_lock = threading.Lock()
+        self._pending_certs: dict[int, object] = {}
+        self.finalized_certificate = None
         # Chain-layer hooks (go_ibft_tpu.chain): on_lock fires when a
         # prepare quorum pins the PC (the WAL's in-flight lock record);
         # on_finalize fires after insert_proposal and BEFORE the store
@@ -260,6 +279,10 @@ class IBFT:
         self._seal_verdicts.clear()
         self._seal_verdict_count = 0
         self._hash_memo.clear()
+        self.finalized_certificate = None
+        with self._cert_lock:
+            for h in [h for h in self._pending_certs if h < height]:
+                del self._pending_certs[h]
         # New sequence: drop the verifier's per-message pack cache (same
         # lifecycle as the seal-verdict cache) and tag round 0.
         bv = self.batch_verifier
@@ -820,7 +843,15 @@ class IBFT:
         (``_seal_verdicts``), so each seal costs exactly one recover no
         matter how many wakeups the phase takes.  The quorum reduction is
         exact host ints over the cached-valid set.
+
+        Aggregate short-circuit: a pending quorum certificate for this
+        height that hash-matches the accepted proposal and verifies (ONE
+        pairing, quorum power from the signer bitmap) finalizes the
+        height immediately — no per-sender COMMIT quorum needed, which is
+        what makes tree-aggregated dissemination O(1) wire per node.
         """
+        if self._certificate_finalizes(view):
+            return True
         commit_messages = self._drain_valid_commits(view)
         if not self._has_quorum_by_msg_type(commit_messages, MessageType.COMMIT):
             return False
@@ -910,6 +941,97 @@ class IBFT:
             else:
                 self._seal_verdict_count -= len(bucket)
                 del self._seal_verdicts[oldest]
+
+    # -- aggregate quorum certificates (ISSUE 7) ------------------------
+
+    def add_quorum_certificate(self, cert) -> bool:
+        """Feed an aggregate COMMIT certificate into the engine (thread-
+        safe; the aggregation-tree transport's delivery seam).
+
+        The certificate is NOT verified here — verification (one pairing
+        equation) runs in the COMMIT drain on the event loop, where the
+        accepted proposal is stable and the cost is attributed to the
+        phase span.  Bounded exactly like the future-message buffer: one
+        pending slot for the live height and one for the next (latest
+        certificate wins a slot; anything staler or further ahead drops).
+        Returns True when the certificate was buffered.
+        """
+        if self.cert_verifier is None or cert is None:
+            return False
+        height = getattr(cert, "height", None)
+        if not isinstance(height, int):
+            return False
+        state_height = self.state.height
+        if not state_height <= height <= state_height + 1:
+            return False
+        with self._cert_lock:
+            self._pending_certs[height] = cert
+        # Wake the COMMIT drain; the subscription re-checks the store AND
+        # the pending slot, so a cert arriving before the engine enters
+        # COMMIT is found by the phase's subscribe-then-recheck.
+        self.messages.signal_event(MessageType.COMMIT, self.state.view)
+        return True
+
+    def _take_pending_cert(self, height: int):
+        with self._cert_lock:
+            return self._pending_certs.pop(height, None)
+
+    def _certificate_finalizes(self, view: View) -> bool:
+        """Try to finalize the view from a pending aggregate certificate.
+
+        Acceptance requires: a verifier is configured, the certificate's
+        proposal hash matches the ACCEPTED proposal (so a certificate can
+        never finalize a proposal this node did not validate), and the
+        certificate verifies — signer bitmap resolves inside the height's
+        validator set, combined voting power reaches quorum, and the one
+        pairing equation holds.  A failing certificate is dropped (the
+        normal per-seal path continues; a fresh certificate can arrive).
+        """
+        cert = self._take_pending_cert(view.height)
+        if cert is None or self.cert_verifier is None:
+            return False
+        proposal = self.state.proposal
+        accepted_hash = self.state.proposal_hash
+        if (
+            proposal is None
+            or accepted_hash is None
+            or getattr(cert, "proposal_hash", None) != accepted_hash
+        ):
+            # Not consumable YET — re-buffer instead of dropping: the hub
+            # broadcasts a certified key exactly once, and under tree
+            # dissemination the certificate may be this node's ONLY
+            # commit evidence.  An equivocation victim that accepted P'
+            # while the quorum certified P re-finds the certificate here
+            # after the round change lands it on P (a newer certificate
+            # arriving meanwhile wins the slot — never overwrite it with
+            # a stale one).  The re-check per wakeup is a bytes compare.
+            with self._cert_lock:
+                self._pending_certs.setdefault(view.height, cert)
+            return False
+        with trace.span(
+            "commit.cert_verify", track=self._obs_track, round=view.round
+        ):
+            try:
+                ok = bool(self.cert_verifier.verify(cert))
+            except Exception as err:  # noqa: BLE001 - a bad cert must not
+                # take down the round; per-seal COMMITs still finalize it
+                self.log.error("quorum certificate verification crashed", err)
+                ok = False
+        if not ok:
+            self.log.debug("quorum certificate rejected")
+            return False
+        trace.instant(
+            "commit.cert_finalize",
+            track=self._obs_track,
+            height=view.height,
+            signers=len(cert.signer_indices())
+            if hasattr(cert, "signer_indices")
+            else None,
+        )
+        self.finalized_certificate = cert
+        self.state.set_committed_seals([cert.to_seal()])
+        self.state.change_state(StateName.FIN)
+        return True
 
     def _proposal_hash_ok(self, proposal: Proposal, hash_: bytes) -> bool:
         """Memoized ``backend.is_valid_proposal_hash`` against the accepted
@@ -1437,13 +1559,25 @@ class IBFT:
 
     def _subscribe(self, details: SubscriptionDetails):
         """Subscribe-then-recheck (closes the missed-message race;
-        reference core/ibft.go:1286-1298)."""
+        reference core/ibft.go:1286-1298).  A pending aggregate quorum
+        certificate counts as a COMMIT wake condition — under tree-
+        aggregated dissemination the certificate may be the ONLY commit
+        evidence this node ever receives, so missing it would stall the
+        phase forever."""
         subscription = self.messages.subscribe(details)
         msgs = self.messages.get_valid_messages(
             details.view, details.message_type, lambda _m: True
         )
         if self._has_quorum_by_msg_type(msgs, details.message_type):
             self.messages.signal_event(details.message_type, details.view)
+        elif (
+            details.message_type == MessageType.COMMIT
+            and self.cert_verifier is not None
+        ):
+            with self._cert_lock:
+                pending = details.view.height in self._pending_certs
+            if pending:
+                self.messages.signal_event(details.message_type, details.view)
         return subscription
 
     # -- state helpers ------------------------------------------------------

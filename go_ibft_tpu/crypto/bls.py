@@ -627,3 +627,46 @@ def aggregate_verify(
 
 def verify(pubkey: PointG1, message: bytes, signature: PointG2) -> bool:
     return aggregate_verify([pubkey], message, signature)
+
+
+# -- proof of possession ----------------------------------------------------
+# Aggregation over attacker-chosen pubkeys is rogue-key-attackable: an
+# attacker registering pk' = pk_rogue - sum(honest pks) can make the
+# AGGREGATE verify for a message no honest party signed.  The standard
+# defense (Ristenpart-Yilek; the eth2 "possession" scheme) is to accept a
+# public key into the aggregation set only with a signature over the key
+# itself under a dedicated domain — producible only by someone holding the
+# secret scalar, which a maliciously derived pk' by construction is not.
+
+_POP_DOMAIN = b"go-ibft-bls-pop-v1:"
+
+
+def pubkey_bytes(pubkey: PointG1) -> bytes:
+    """Canonical 96-byte uncompressed encoding of a G1 public key."""
+    if pubkey is None:
+        raise ValueError("cannot encode the point at infinity as a pubkey")
+    x, y = pubkey
+    return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def possession_message(pubkey: PointG1) -> bytes:
+    """The domain-separated bytes a proof of possession signs.
+
+    Domain separation matters twice over: a PoP must never be confusable
+    with a committed seal (seals sign 32-byte proposal hashes; this is
+    prefix + 96 bytes), and a seal must never double as a PoP."""
+    return _POP_DOMAIN + pubkey_bytes(pubkey)
+
+
+def prove_possession(key: "BLSPrivateKey") -> PointG2:
+    """Sign one's own public key under the PoP domain."""
+    return key.sign(possession_message(key.pubkey))
+
+
+def verify_possession(pubkey: PointG1, proof: PointG2) -> bool:
+    """Check that ``proof`` demonstrates knowledge of ``pubkey``'s scalar."""
+    if pubkey is None or proof is None:
+        return False
+    if not g1_on_curve(pubkey) or g1_mul(R, pubkey) is not None:
+        return False
+    return verify(pubkey, possession_message(pubkey), proof)

@@ -12,9 +12,14 @@ backends behind the same seam:
   validator per mesh device, "multicast" is an ``all_gather`` of
   fixed-size message tensors over ICI, consensus rounds become lock-step
   collective steps.
+* :class:`AggregationTreeGossip` — aggregate-signature COMMIT
+  dissemination (ISSUE 7): seals merge up a fan-in tree as partial
+  aggregates and ONE quorum certificate broadcasts down, so per-node
+  COMMIT wire cost stops scaling with committee size.
 """
 
+from .aggtree import AggregationTreeGossip
 from .grpc_transport import GrpcTransport
 from .ici import IciLockstepTransport
 
-__all__ = ["GrpcTransport", "IciLockstepTransport"]
+__all__ = ["AggregationTreeGossip", "GrpcTransport", "IciLockstepTransport"]

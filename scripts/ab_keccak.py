@@ -3,9 +3,15 @@
 Decides the Pallas kernel's fate with data (VERDICT r03 weak #4): run on a
 live TPU backend and compare p50s at consensus-relevant batch sizes.  On
 CPU the Pallas kernel runs in interpret mode — those numbers say nothing
-about TPU; the script labels the platform on every line.
+about TPU perf, but the run still proves the kernel TRACES and matches the
+XLA route bit-for-bit (the CI forced-host mode, ISSUE 7 satellite: the
+kernel had never executed in any mode before this job existed).
 
-Usage: python scripts/ab_keccak.py [--sizes 100,200,1000] [--reps 30]
+If Pallas itself is unavailable on the pinned jax (import failure, missing
+interpret support), the script SKIPS with an explicit reason and exit
+code 0 — an environment gap is not a parity failure.
+
+Usage: python scripts/ab_keccak.py [--sizes 100,200,1000] [--reps 30] [--cpu]
 """
 
 import argparse
@@ -15,7 +21,7 @@ import statistics
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The baseline arm times keccak_f's XLA-scan path; with GO_IBFT_PALLAS
 # exported (the very flag under evaluation) keccak_f would route BOTH arms
@@ -23,12 +29,16 @@ sys.path.insert(0, ".")
 os.environ.pop("GO_IBFT_PALLAS", None)
 
 
-def main() -> None:
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", default="100,200,1000")
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--cpu", action="store_true", help="pin CPU (interpret mode)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     import jax
 
@@ -46,13 +56,21 @@ def main() -> None:
     enable_persistent_cache()
 
     from go_ibft_tpu.ops.keccak import keccak_f
-    from go_ibft_tpu.ops.pallas_keccak import keccak_f_pallas, pallas_supported
+
+    try:
+        from go_ibft_tpu.ops.pallas_keccak import (
+            keccak_f_pallas,
+            pallas_supported,
+        )
+    except Exception as err:  # noqa: BLE001 - pallas missing on this jax
+        log(
+            skipped="pallas unavailable on the pinned jax",
+            reason=f"{type(err).__name__}: {err}"[:200],
+        )
+        return 0
 
     platform = jax.devices()[0].platform
     interpret = not pallas_supported()
-
-    def log(**kw):
-        print(json.dumps(kw), flush=True)
 
     log(platform=platform, pallas_interpret=interpret)
 
@@ -73,12 +91,27 @@ def main() -> None:
         state = jnp.asarray(
             rng.integers(0, 2**32, (b, 25, 2), dtype=np.uint32)
         )
+        try:
+            p = med(pal, state)
+        except Exception as err:  # noqa: BLE001 - kernel cannot trace/run
+            # Pallas IMPORTED but the kernel failed to trace/execute:
+            # that is a regression of exactly the property this gate
+            # exists to hold (the kernel must at least run in interpret
+            # mode), not an environment gap — fail the job.
+            log(
+                error="pallas kernel failed to compile/run",
+                batch=b,
+                reason=f"{type(err).__name__}: {err}"[:200],
+            )
+            return 1
         x = med(xla, state)
-        p = med(pal, state)
         # parity gate: same permutation
-        assert (np.asarray(xla(state)) == np.asarray(pal(state))).all()
+        assert (np.asarray(xla(state)) == np.asarray(pal(state))).all(), (
+            f"pallas kernel diverges from the XLA route at batch {b}"
+        )
         log(batch=b, xla_scan_ms=x, pallas_ms=p, speedup=round(x / p, 2))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

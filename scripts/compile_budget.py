@@ -80,7 +80,20 @@ def _programs() -> dict:
     # Both must stay thin shells around the single-chip program — SPMD
     # propagation or a collective regression that re-traces the EC ladder
     # per shard shows up as per-dp line growth here first.
+    # The aggregate-BLS pairing program (ISSUE 7): by far the largest
+    # trace in the repo (~414k stablehlo lines at 8 lanes on jax 0.4.37)
+    # and therefore the most cold-compile-sensitive — a tower-arithmetic
+    # refactor that re-instantiates the Fp12 ops per call site would add
+    # MINUTES of compile before any pairing runs.  Lowered at the same
+    # 8-lane shape as the other engine-route pins.
+    from go_ibft_tpu.bench.bls_workload import build_bls_round_workload
+    from go_ibft_tpu.ops.bls12_381 import aggregate_verify_commit
+
+    bls_w = build_bls_round_workload(8, time_host=False)
+    bls_args = tuple(jnp.asarray(a) for a in bls_w.args)
+
     out = {
+        "bls_aggregate_verify_8v": lines(aggregate_verify_commit, *bls_args),
         "quorum_certify_8l": lines(
             quorum.quorum_certify,
             blocks, counts, limbs, limbs, v, addr, table, live, power, power,

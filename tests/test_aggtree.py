@@ -1,0 +1,456 @@
+"""Aggregation-tree gossip: wire shape, merge semantics, and consensus e2e.
+
+The hub tests use sink callbacks (no engines, no pairings) so the tree
+mechanics — disjoint-subtree merging, dedup, the O(1)-per-sweep send
+rate, certificate broadcast — are pinned cheaply; one 4-node consensus
+test drives the full stack (engines finalize from the tree-built
+certificate, one pairing each).
+"""
+
+import asyncio
+
+import pytest
+
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import bls as hbls
+from go_ibft_tpu.crypto.quorum_cert import BLSCertifier
+from go_ibft_tpu.messages.wire import (
+    CommitMessage,
+    IbftMessage,
+    MessageType,
+    PrepareMessage,
+    View,
+)
+from go_ibft_tpu.net import AggregationTreeGossip
+from go_ibft_tpu.verify.bls import encode_seal
+
+from harness import NullLogger
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def committee():
+    eck = [PrivateKey.from_seed(b"agt-%d" % i) for i in range(N)]
+    blk = [hbls.BLSPrivateKey.from_seed(b"agt-%d" % i) for i in range(N)]
+    powers = {k.address: 1 for k in eck}
+    keys = {e.address: b.pubkey for e, b in zip(eck, blk)}
+    return eck, blk, powers, keys
+
+
+@pytest.fixture(scope="module")
+def certifier(committee):
+    _eck, _blk, powers, keys = committee
+    return BLSCertifier(lambda _h: powers, lambda _h: keys)
+
+
+def _commit(e, b, phash, height=1):
+    return IbftMessage(
+        view=View(height=height, round=0),
+        sender=e.address,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(
+            proposal_hash=phash, committed_seal=encode_seal(b.sign(phash))
+        ),
+    )
+
+
+def _hub_with_sinks(committee, certifier, **kw):
+    eck, _blk, _powers, _keys = committee
+    hub = AggregationTreeGossip(certifier, **kw)
+    delivered = [[] for _ in range(N)]
+    certs = [[] for _ in range(N)]
+    ports = [
+        hub.register(e.address, delivered[i].append, certs[i].append)
+        for i, e in enumerate(eck)
+    ]
+    return hub, ports, delivered, certs
+
+
+def test_tree_aggregates_commits_into_one_cert(committee, certifier):
+    eck, blk, _powers, _keys = committee
+    hub, ports, delivered, certs = _hub_with_sinks(committee, certifier)
+    phash = b"t" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        ports[i].multicast(_commit(e, b, phash))
+    assert hub.certs_built == 1
+    # every node received the certificate and it verifies
+    for got in certs:
+        assert len(got) == 1
+        assert got[0].proposal_hash == phash
+    assert certifier.verify(certs[0][0])
+    # commits did NOT flood: each node saw only its own commit
+    for i, msgs in enumerate(delivered):
+        assert [m.sender for m in msgs] == [eck[i].address]
+
+
+def test_tree_wire_cost_beats_flooding(committee, certifier):
+    """The headline wire claim, measured not asserted-by-construction:
+    the worst node's COMMIT-phase bytes must be well under what full-mesh
+    flooding would cost it (N-1 outbound copies of its own commit, i.e.
+    the O(N^2)/N per-node share)."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, _certs = _hub_with_sinks(committee, certifier)
+    phash = b"w" * 32
+    msgs = [_commit(e, b, phash) for e, b in zip(eck, blk)]
+    for i, m in enumerate(msgs):
+        ports[i].multicast(m)
+    stats = hub.stats()
+    flood_per_node = (N - 1) * len(msgs[0].encode())
+    assert max(stats["commit_bytes_per_node"]) < flood_per_node
+    assert stats["fan_in"] == 2 and stats["depth"] == 3
+
+
+def test_tree_batched_pump_caps_per_sweep_sends(committee, certifier):
+    """In periodic mode (auto_pump off) all N contributions buffered
+    before one sweep cost each node at most ONE upward partial — the
+    send-rate cap that makes per-node wire cost committee-size-free."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, certs = _hub_with_sinks(
+        committee, certifier, auto_pump=False
+    )
+    phash = b"b" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        ports[i].multicast(_commit(e, b, phash))
+    assert hub.certs_built == 0  # nothing relayed yet
+    hub.pump()
+    stats = hub.stats()
+    assert hub.certs_built == 1
+    assert all(c[0] is not None for c in certs)
+    # one in-flight key, one sweep: <= 1 upward partial per node
+    assert max(stats["commit_msgs_per_node"][1:]) <= 1 + hub.fan_in
+    up_only = [
+        m - (hub.fan_in if i == 0 else len(hub._children(i)))
+        for i, m in enumerate(stats["commit_msgs_per_node"])
+    ]
+    assert all(u <= 1 for u in up_only)
+
+
+def test_tree_dedups_duplicate_commits(committee, certifier):
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, _certs = _hub_with_sinks(committee, certifier)
+    phash = b"d" * 32
+    msg = _commit(eck[3], blk[3], phash)
+    ports[3].multicast(msg)
+    stats_before = hub.stats()
+    ports[3].multicast(msg)  # identical re-send
+    stats_after = hub.stats()
+    assert (
+        stats_after["commit_msgs_per_node"]
+        == stats_before["commit_msgs_per_node"]
+    )
+
+
+def test_non_bls_traffic_floods(committee, certifier):
+    """PREPAREs (and any COMMIT whose seal is not a decodable BLS point —
+    an ECDSA cluster) take the reference flood path unchanged."""
+    eck, _blk, _powers, _keys = committee
+    hub, ports, delivered, _certs = _hub_with_sinks(committee, certifier)
+    prepare = IbftMessage(
+        view=View(height=1, round=0),
+        sender=eck[0].address,
+        type=MessageType.PREPARE,
+        prepare_data=PrepareMessage(proposal_hash=b"f" * 32),
+    )
+    ports[0].multicast(prepare)
+    assert all(len(msgs) == 1 for msgs in delivered)
+    ecdsa_commit = IbftMessage(
+        view=View(height=1, round=0),
+        sender=eck[0].address,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(
+            proposal_hash=b"f" * 32, committed_seal=b"\x01" * 65
+        ),
+    )
+    ports[0].multicast(ecdsa_commit)
+    assert all(len(msgs) == 2 for msgs in delivered)
+    assert hub.certs_built == 0
+
+
+def test_malformed_hash_commit_floods_instead_of_poisoning_pump(
+    committee, certifier
+):
+    """A COMMIT with a valid BLS seal but a non-32-byte proposal hash must
+    take the flood path — buffered in the tree it would blow up the
+    certificate codec inside pump() and kill the cadence task."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, delivered, _certs = _hub_with_sinks(committee, certifier)
+    bad = IbftMessage(
+        view=View(height=1, round=0),
+        sender=eck[0].address,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(
+            proposal_hash=b"short",
+            committed_seal=encode_seal(blk[0].sign(b"short")),
+        ),
+    )
+    ports[0].multicast(bad)
+    hub.pump()  # must not raise
+    assert all(len(msgs) == 1 for msgs in delivered)  # flooded
+    assert hub.certs_built == 0
+
+
+def test_foreign_sender_commit_floods(committee, certifier):
+    """A COMMIT from an address with no registered key floods instead of
+    entering the aggregate path, where it would make every
+    build_from_aggregate for the round fail."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, delivered, _certs = _hub_with_sinks(committee, certifier)
+    phash = b"g" * 32
+    outsider = IbftMessage(
+        view=View(height=1, round=0),
+        sender=b"\x42" * 20,
+        type=MessageType.COMMIT,
+        commit_data=CommitMessage(
+            proposal_hash=phash,
+            committed_seal=encode_seal(blk[0].sign(phash)),
+        ),
+    )
+    ports[0].multicast(outsider)
+    assert all(len(msgs) == 1 for msgs in delivered)
+    # the honest quorum still certifies afterwards
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        ports[i].multicast(_commit(e, b, phash))
+    assert hub.certs_built == 1
+
+
+def test_byzantine_seal_quarantined_honest_quorum_certifies(
+    committee, certifier
+):
+    """One validator's decodable-but-invalid seal (signed over the wrong
+    message) must not poison the round: the root's verify-before-
+    broadcast catches it, the quarantine walk evicts exactly that leaf,
+    and the certificate still certifies from the honest quorum."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, certs = _hub_with_sinks(committee, certifier)
+    phash = b"z" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        if i == 1:
+            msg = IbftMessage(
+                view=View(height=1, round=0),
+                sender=e.address,
+                type=MessageType.COMMIT,
+                commit_data=CommitMessage(
+                    proposal_hash=phash,
+                    committed_seal=encode_seal(b.sign(b"not the hash")),
+                ),
+            )
+        else:
+            msg = _commit(e, b, phash)
+        ports[i].multicast(msg)
+    assert hub.certs_built == 1
+    assert hub.rejected_partials == 1
+    cert = certs[0][0]
+    assert certifier.verify(cert)
+    # the Byzantine signer is NOT in the certificate; quorum of honest
+    # signers is (the root certifies at first quorum, so late honest
+    # commits may land after the certificate — >= quorum, not == N-1)
+    powers = {k.address: 1 for k in eck}
+    signers = cert.signers(sorted(powers))
+    assert eck[1].address not in signers
+    assert len(signers) >= (2 * N) // 3 + 1
+
+
+def test_negated_seal_cancellation_cannot_kill_pump(committee, certifier):
+    """A Byzantine member whose 'seal' is the NEGATION of a sibling's
+    seal cancels the merged partial to the point at infinity — the pump
+    must relay through it (zero-encoded partial), and the honest quorum
+    must still certify once the root's quarantine evicts the leaf."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, certs = _hub_with_sinks(committee, certifier)
+    phash = b"n" * 32
+    ports[1].multicast(_commit(eck[1], blk[1], phash))
+    neg = hbls.g2_neg(blk[1].sign(phash))
+    ports[3].multicast(  # node 3 claims the negation as its own seal
+        IbftMessage(
+            view=View(height=1, round=0),
+            sender=eck[3].address,
+            type=MessageType.COMMIT,
+            commit_data=CommitMessage(
+                proposal_hash=phash, committed_seal=encode_seal(neg)
+            ),
+        )
+    )  # node 1's subtree merge is now the point at infinity
+    for i in (0, 2, 4, 5, 6, 7):
+        ports[i].multicast(_commit(eck[i], blk[i], phash))
+    assert hub.certs_built == 1
+    assert hub.rejected_partials >= 1
+    cert = certs[0][0]
+    assert certifier.verify(cert)
+    powers = {k.address: 1 for k in eck}
+    assert eck[3].address not in cert.signers(sorted(powers))
+
+
+def test_forged_height_cannot_wipe_inflight_state(committee, certifier):
+    """Relay-state GC anchors to certified progress: a COMMIT claiming an
+    absurd future height must not flush the live round's partials."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, _certs = _hub_with_sinks(committee, certifier)
+    phash = b"h" * 32
+    # half the committee commits...
+    for i in range(N // 2):
+        ports[i].multicast(_commit(eck[i], blk[i], phash))
+    # ...then a forged far-future commit arrives
+    ports[3].multicast(_commit(eck[3], blk[3], b"f" * 32, height=10**6))
+    # ...and the rest of the live round still certifies
+    for i in range(N // 2, N):
+        ports[i].multicast(_commit(eck[i], blk[i], phash))
+    assert hub.certs_built == 1
+
+
+def test_inflight_key_set_is_bounded(committee, certifier):
+    """Minting bogus (round, hash) keys cannot grow relay state past the
+    cap; the live round still completes."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, _certs = _hub_with_sinks(committee, certifier)
+    hub.max_inflight_keys = 4
+    for r in range(12):  # spam distinct keys from one node
+        ports[2].multicast(
+            IbftMessage(
+                view=View(height=2, round=r),
+                sender=eck[2].address,
+                type=MessageType.COMMIT,
+                commit_data=CommitMessage(
+                    proposal_hash=bytes([r]) * 32,
+                    committed_seal=encode_seal(blk[2].sign(bytes([r]) * 32)),
+                ),
+            )
+        )
+    assert len(hub._live) <= hub.max_inflight_keys
+    # a HIGHER height evicts spam and certifies normally
+    phash = b"k" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        ports[i].multicast(_commit(e, b, phash, height=3))
+    assert hub.certs_built == 1
+
+
+def test_high_height_spam_cannot_starve_live_round(committee, certifier):
+    """One Byzantine validator minting MORE distinct forged high-height
+    keys than the whole in-flight window holds must not starve the live
+    round out of the tree: admission is attributed per sender, so the
+    spammer's keys evict each other while honest keys keep their slots
+    and the round still certifies through the tree (no flood fallback
+    needed)."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, delivered, _certs = _hub_with_sinks(committee, certifier)
+    for j in range(hub.max_inflight_keys + 8):  # overfill the window
+        ports[3].multicast(
+            _commit(eck[3], blk[3], bytes([j % 251]) * 32, height=100 + j)
+        )
+    # spam holds only the spammer's per-sender allowance, not the window
+    assert len(hub._live) <= hub.max_keys_per_sender
+    phash = b"s" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        ports[i].multicast(_commit(e, b, phash))
+    assert hub.certs_built == 1
+    # honest commits rode the tree (self-delivery only), never flooded
+    for i, msgs in enumerate(delivered):
+        honest = [m for m in msgs if m.commit_data.proposal_hash == phash]
+        assert [m.sender for m in honest] == [eck[i].address]
+
+
+def test_refused_key_floods_instead_of_dropping(committee, certifier):
+    """A COMMIT whose key loses window admission degrades to the
+    reference flood path — a full in-flight window costs wire
+    efficiency, never message loss."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, delivered, _certs = _hub_with_sinks(committee, certifier)
+    hub.max_inflight_keys = 1
+    ports[0].multicast(_commit(eck[0], blk[0], b"hi" * 16, height=5))
+    # height 1 <= the only live key's height 5: admission refused
+    ports[1].multicast(_commit(eck[1], blk[1], b"lo" * 16, height=1))
+    for msgs in delivered:
+        assert eck[1].address in [m.sender for m in msgs]
+
+
+def test_root_total_cancellation_quarantined(committee, certifier):
+    """A Byzantine seal equal to the negation of the SUM of the other
+    merged seals cancels the root's aggregate to the point at infinity
+    at quorum power.  Certification must quarantine (not early-return):
+    the Byzantine leaf is evicted, and the round certifies once honest
+    power alone reaches quorum."""
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, certs = _hub_with_sinks(committee, certifier)
+    phash = b"c" * 32
+    honest = (0, 2, 4, 5, 6)  # 5 honest seals — one short of quorum (6)
+    for i in honest:
+        ports[i].multicast(_commit(eck[i], blk[i], phash))
+    neg = None
+    for i in honest:
+        neg = hbls.g2_add(neg, blk[i].sign(phash))
+    neg = hbls.g2_neg(neg)
+    ports[3].multicast(  # signer count hits quorum, point hits infinity
+        IbftMessage(
+            view=View(height=1, round=0),
+            sender=eck[3].address,
+            type=MessageType.COMMIT,
+            commit_data=CommitMessage(
+                proposal_hash=phash, committed_seal=encode_seal(neg)
+            ),
+        )
+    )
+    assert hub.certs_built == 0  # honest power below quorum post-eviction
+    assert hub.rejected_partials >= 1
+    ports[7].multicast(_commit(eck[7], blk[7], phash))  # 6th honest seal
+    assert hub.certs_built == 1
+    cert = certs[0][0]
+    assert certifier.verify(cert)
+    powers = {k.address: 1 for k in eck}
+    assert eck[3].address not in cert.signers(sorted(powers))
+
+
+def test_tree_consensus_end_to_end(committee, certifier):
+    """4 engines over the tree finalize a height from the certificate:
+    commits never flood, every node's finalized evidence IS the O(1)
+    certificate (one pairing per node to accept)."""
+    from go_ibft_tpu.core import IBFT
+    from go_ibft_tpu.crypto.bls_backend import (
+        HybridBLSBackend,
+        HybridBatchVerifier,
+    )
+    from go_ibft_tpu.verify import HostBatchVerifier
+    from go_ibft_tpu.verify.bls import BLSAggregateVerifier
+
+    eck, blk, _powers, keys_all = committee
+    eck, blk = eck[:4], blk[:4]
+    powers = {k.address: 1 for k in eck}
+    keys = {e.address: keys_all[e.address] for e in eck}
+    src = lambda _h: powers  # noqa: E731
+    certifier4 = BLSCertifier(src, lambda _h: keys)
+    hub = AggregationTreeGossip(certifier4, fan_in=2)
+    nodes = []
+    for e, b in zip(eck, blk):
+        backend = HybridBLSBackend(e, b, src, lambda _h: keys)
+        verifier = HybridBatchVerifier(
+            HostBatchVerifier(src), BLSAggregateVerifier(lambda _h: keys, device=False)
+        )
+        core = IBFT(
+            NullLogger(),
+            backend,
+            None,
+            batch_verifier=verifier,
+            cert_verifier=certifier4,
+        )
+        core.set_base_round_timeout(60.0)
+        core.transport = hub.register(
+            e.address, core.add_message, core.add_quorum_certificate
+        )
+        nodes.append(core)
+
+    async def run():
+        hub.start()
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(c.run_sequence(1) for c in nodes)), 120
+            )
+        finally:
+            await hub.stop()
+            for c in nodes:
+                c.messages.close()
+
+    asyncio.run(run())
+    for c in nodes:
+        assert len(c.backend.inserted) == 1
+        assert c.finalized_certificate is not None
+        assert certifier4.verify(c.finalized_certificate)
+    assert hub.certs_built == 1

@@ -67,6 +67,7 @@ _FIVE_CONFIG_KEYS = (
     "chaos_degraded_overhead_100v",
     "chain_sustained_20h_100v",
     "mesh_sharded_drain_8k_100v",
+    "aggregate_commit_cert_100v",
     bench.headline_metric(True),
 )
 
@@ -212,6 +213,35 @@ def test_driver_conditions_config8_mesh_evidence(driver_run):
         ]
     assert len(evidence) == 1
     assert "devices" in evidence[0]
+
+
+def test_driver_conditions_config9_aggregate_evidence(driver_run):
+    """Config #9's evidence schema (ISSUE 7): a MEASURED aggregate-COMMIT
+    line on the CPU fallback carrying the aggregate-vs-per-seal ratio,
+    the O(1) certificate size, the pairing p50, and the tree fan-in; the
+    ops counts pin the acceptance claim (1 pairing equation + aggregation
+    vs a quorum of recovers at 100 validators), the bisect sub-record
+    pins oracle-exact verdicts on the seeded Byzantine mix, and the tree
+    sub-record pins per-node COMMIT bytes under the flooding share."""
+    _, by_metric, _ = driver_run
+    line = by_metric["aggregate_commit_cert_100v"]
+    assert line["value"] > 0
+    for field in ("ratio", "cert_bytes", "pairing_ms", "fan_in", "quorum"):
+        assert field in line, (field, line)
+    assert line["vs_baseline"] == line["ratio"]
+    ops = line["verify_ops"]
+    assert ops["aggregate_pairing_eqs"] == 1
+    assert ops["per_seal_recovers"] == (2 * line["validators"]) // 3 + 1
+    assert ops["aggregate_pairing_eqs"] < ops["per_seal_recovers"]
+    # O(1) evidence: header + hash + one G2 point + 1 bit per validator
+    assert line["cert_bytes"] == 15 + 32 + 192 + (line["validators"] + 7) // 8
+    bisect = line["bisect"]
+    assert bisect["oracle_exact"] is True
+    assert bisect["equations"] > 1
+    if line["quorum"] > 8:  # the saving claim needs a real committee
+        assert bisect["equations"] < line["quorum"]
+    tree = line["tree"]
+    assert tree["max_commit_bytes_per_node"] < tree["flood_bytes_per_node"]
 
 
 def test_mesh_only_flag_scopes_evidence_contract():

@@ -1,0 +1,165 @@
+"""Hybrid BLS backend/verifier under the chaos harness (ISSUE 7 satellite).
+
+Seeded, replayable corruption (the :mod:`go_ibft_tpu.chaos` discipline)
+drives the aggregate seal path through its unhappy branches — bit-flipped
+192-byte seals, wrong-proposal-hash seals, injected device faults — and
+every verdict is pinned to the sequential host oracle
+(``HybridBLSBackend.is_valid_committed_seal``, one pairing per seal, the
+reference Backend semantics).  The aggregate-then-bisect route must agree
+bit-for-bit AND spend fewer pairing equations than the per-seal loop.
+"""
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.chaos import ChaoticVerifier, FaultConfig, FaultInjector
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import bls as hbls
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.utils import metrics
+from go_ibft_tpu.verify.bls import (
+    BLSAggregateVerifier,
+    PAIRING_EQS_KEY,
+    encode_seal,
+)
+
+N = 5
+SEED = 20260804
+PHASH = b"c" * 32
+
+
+@pytest.fixture(scope="module")
+def committee():
+    eck = [PrivateKey.from_seed(b"blsx-%d" % i) for i in range(N)]
+    blk = [hbls.BLSPrivateKey.from_seed(b"blsx-%d" % i) for i in range(N)]
+    powers = {k.address: 1 for k in eck}
+    keys = {e.address: b.pubkey for e, b in zip(eck, blk)}
+    return eck, blk, powers, keys
+
+
+@pytest.fixture(scope="module")
+def chaotic_seals(committee):
+    """Seeded Byzantine seal mix: one bit-flipped seal, one wrong-hash
+    seal, the rest honest.  The flip position comes from the chaos
+    harness's per-site PRNG stream, so the mix replays byte-identically
+    from the seed."""
+    eck, blk, _powers, _keys = committee
+    injector = FaultInjector(SEED, FaultConfig(corrupt_rate=1.0))
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(PHASH)))
+        for e, b in zip(eck, blk)
+    ]
+    fault = injector.transport_fault("bls-seal-corrupt")
+    assert fault.corrupt_bit >= 0
+    flipped = bytearray(seals[1].signature)
+    bit = fault.corrupt_bit % (len(flipped) * 8)
+    flipped[bit // 8] ^= 1 << (bit % 8)
+    seals[1] = CommittedSeal(seals[1].signer, bytes(flipped))
+    # a structurally perfect seal over the WRONG proposal hash
+    seals[3] = CommittedSeal(
+        eck[3].address, encode_seal(blk[3].sign(b"x" * 32))
+    )
+    return seals
+
+
+@pytest.fixture(scope="module")
+def oracle_mask(committee, chaotic_seals):
+    """The sequential host oracle: the reference per-seal Backend check."""
+    from go_ibft_tpu.crypto.bls_backend import HybridBLSBackend
+
+    eck, blk, powers, keys = committee
+    backend = HybridBLSBackend(
+        eck[0], blk[0], lambda _h: powers, lambda _h: keys
+    )
+    mask = np.array(
+        [
+            backend.is_valid_committed_seal(PHASH, seal, height=1)
+            for seal in chaotic_seals
+        ]
+    )
+    # the mix must actually exercise both corruption kinds
+    assert list(mask) == [True, False, True, False, True]
+    return mask
+
+
+def test_aggregate_bisect_verdicts_pin_oracle(
+    committee, chaotic_seals, oracle_mask
+):
+    _eck, _blk, _powers, keys = committee
+    verifier = BLSAggregateVerifier(lambda _h: keys, device=False)
+    before = metrics.get_counter(PAIRING_EQS_KEY)
+    mask = verifier.verify_committed_seals(PHASH, chaotic_seals, height=1)
+    equations = metrics.get_counter(PAIRING_EQS_KEY) - before
+    assert (mask == oracle_mask).all()
+    # aggregate-then-bisect: more than the 1-equation happy path, but
+    # strictly under the N per-seal equations the old fallback spent
+    # (k=2 bad of 5 -> O(k log n))
+    assert 1 < equations < N + 1
+
+
+def test_hybrid_batch_verifier_routes_both_planes(committee, chaotic_seals):
+    """HybridBatchVerifier composition: ECDSA envelopes keep their mask
+    semantics while the seal plane runs the aggregate route — both pinned
+    against the same chaotic mix."""
+    from go_ibft_tpu.crypto.bls_backend import (
+        HybridBLSBackend,
+        HybridBatchVerifier,
+    )
+    from go_ibft_tpu.messages.wire import View
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    eck, blk, powers, keys = committee
+    src = lambda _h: powers  # noqa: E731
+    backends = [
+        HybridBLSBackend(e, b, src, lambda _h: keys)
+        for e, b in zip(eck, blk)
+    ]
+    msgs = [
+        b.build_commit_message(PHASH, View(height=1, round=0))
+        for b in backends
+    ]
+    # corrupt one envelope signature (the ECDSA plane)
+    msgs[2].signature = msgs[2].signature[:-1] + bytes(
+        [msgs[2].signature[-1] ^ 0xFF]
+    )
+    hybrid = HybridBatchVerifier(
+        HostBatchVerifier(src), BLSAggregateVerifier(lambda _h: keys, device=False)
+    )
+    env_mask = np.asarray(hybrid.verify_senders(msgs))
+    assert list(env_mask) == [True, True, False, True, True]
+    seal_mask = np.asarray(
+        hybrid.verify_committed_seals(PHASH, chaotic_seals, 1)
+    )
+    assert list(seal_mask) == [True, False, True, False, True]
+
+
+def test_resilient_ladder_with_bls_rungs(committee):
+    """The ladder posture of the tentpole: a ChaoticVerifier-injected
+    device fault on the aggregate rung demotes to the (BLS) host rung
+    without changing a single verdict — same contract as the ECDSA
+    ladder, same breaker machinery."""
+    from go_ibft_tpu.verify import CircuitBreaker, ResilientBatchVerifier
+
+    eck, blk, powers, keys = committee
+    injector = FaultInjector(
+        SEED, FaultConfig(device_error_rate=0.0, device_error_burst=1)
+    )
+    src = lambda _h: keys  # noqa: E731
+    chaotic = ChaoticVerifier(
+        BLSAggregateVerifier(src, device=False), injector, site="verify:bls"
+    )
+    resilient = ResilientBatchVerifier(
+        chaotic,
+        host=BLSAggregateVerifier(src, device=False),
+        python=BLSAggregateVerifier(src, device=False),
+        breaker=CircuitBreaker(k=3, cooldown_s=0.05),
+    )
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(PHASH)))
+        for e, b in zip(eck[:3], blk[:3])
+    ]
+    mask = resilient.verify_committed_seals(PHASH, seals, 1)
+    assert mask.all()
+    assert (
+        metrics.get_counter(("go-ibft", "chaos", "device_errors")) >= 1
+    )

@@ -2,7 +2,7 @@
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
-	ab-keccak
+	ab-keccak tenant-bench sched-soak
 
 test:
 	python -m pytest tests/ -q
@@ -40,6 +40,18 @@ mesh-bench:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	GO_IBFT_MESH_BENCH=1 GO_IBFT_BENCH_BUDGET_S=1800 \
 	python bench.py --mesh-only
+
+# Multi-tenant bench (config #10): N concurrent real-crypto chains
+# through ONE process-wide TenantScheduler vs the same chains run
+# serially.  GO_IBFT_TENANTS overrides the 8-chain default.
+tenant-bench:
+	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=900 \
+	python bench.py --tenant-only
+
+# Multi-tenant fairness soak: hot + slow chains sharing one scheduler
+# under seeded chaos (tests/test_sched_consensus.py, slow tier included)
+sched-soak:
+	python -m pytest tests/test_sched.py tests/test_sched_consensus.py -q
 
 # Stablehlo-line budgets for the hot programs, incl. the mesh program at
 # dp=2/4/8 (trace size IS cold-compile time on XLA:CPU)

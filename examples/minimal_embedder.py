@@ -263,6 +263,103 @@ async def main_chain(
     _print_chains(engines)
 
 
+def main_tenants(n: int, heights: int, tenants: int) -> None:
+    """Multi-tenant mode (``--tenants N``): N independent chains — their
+    own validator sets, proposals and WALs — share ONE process-wide
+    :class:`~go_ibft_tpu.sched.TenantScheduler`, so every chain's verify
+    drains coalesce into shared batched dispatches instead of issuing N
+    small ones (docs/TENANCY.md).  Each chain runs in its own event-loop
+    thread (the many-embedders-one-process posture); per-tenant drain
+    latency SLOs print at the end from ``scheduler.stats()``.
+    """
+    import threading
+
+    from go_ibft_tpu.chain import ChainRunner
+    from go_ibft_tpu.core import BatchingIngress
+    from go_ibft_tpu.sched import TenantScheduler
+
+    scheduler = TenantScheduler(window_s=0.001, route="auto")
+
+    async def one_chain(chain: int) -> list:
+        keys = [
+            PrivateKey.from_seed(b"tenant-%d-validator-%d" % (chain, i))
+            for i in range(n)
+        ]
+        validators = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        nodes = []
+
+        class _T:
+            def multicast(self, message):
+                for ingress in nodes:
+                    ingress.submit(message)
+
+        runners = []
+        for i, key in enumerate(keys):
+            handle = scheduler.register(
+                f"chain-{chain}/node-{i}", validators, chain_id=f"chain-{chain}"
+            )
+            build = (
+                lambda view, c=chain: b"tenant %d block %d" % (c, view.height)
+            )  # noqa: E731
+            engine = IBFT(
+                StdoutLogger() if chain == 0 and i == 0 else _QuietLogger(),
+                ECDSABackend(key, validators, build_proposal_fn=build),
+                _T(),
+                batch_verifier=handle,
+            )
+            engine.set_base_round_timeout(10.0)
+            nodes.append(BatchingIngress(engine.add_messages))
+            runners.append(ChainRunner(engine, overlap=False))
+        try:
+            await asyncio.gather(*(r.run(until_height=heights) for r in runners))
+        finally:
+            for r, ingress in zip(runners, nodes):
+                ingress.close()
+                r.engine.messages.close()
+        return [b.proposal.raw_proposal for b in runners[0].chain]
+
+    chains: dict = {}
+
+    def chain_thread(chain: int) -> None:
+        chains[chain] = asyncio.run(one_chain(chain))
+
+    with scheduler:
+        threads = [
+            threading.Thread(target=chain_thread, args=(c,))
+            for c in range(tenants)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    stats = scheduler.stats()
+    for c in sorted(chains):
+        blocks = [b.decode() for b in chains[c]]
+        p99 = max(
+            (
+                t["drain_p99_ms"]
+                for t in stats["tenants"].values()
+                if t["chain"] == f"chain-{c}" and t["drain_p99_ms"] is not None
+            ),
+            default=None,
+        )
+        print(f"chain {c}: {blocks} drain_p99_ms={p99}")
+    print(
+        f"scheduler: {stats['coalesced_requests']} requests coalesced into "
+        f"{stats['dispatches']} dispatches "
+        f"(ratio {stats['coalesce_ratio']}), "
+        f"{stats['flush_faults']} flush faults"
+    )
+
+
+class _QuietLogger:
+    def info(self, msg, *args):
+        pass
+
+    debug = error = info
+
+
 def _print_chains(engines) -> None:
     for i, e in enumerate(engines):
         chain = [p.raw_proposal.decode() for p, _seals in e.backend.inserted]
@@ -313,15 +410,28 @@ if __name__ == "__main__":
         "height loops, WAL + block-sync) instead of the per-height "
         "gather barrier",
     )
-    args = ap.parse_args()
-    runner = main_chain if args.chain else main_async
-    asyncio.run(
-        runner(
-            args.nodes,
-            args.heights,
-            args.device,
-            args.bls,
-            args.mesh,
-            args.aggregate,
-        )
+    ap.add_argument(
+        "--tenants",
+        type=int,
+        default=0,
+        metavar="N",
+        help="multi-tenant mode: run N independent chains (each --nodes "
+        "validators) whose verify drains coalesce through ONE process-"
+        "wide TenantScheduler (docs/TENANCY.md); prints per-tenant drain "
+        "p99 and the coalesce ratio",
     )
+    args = ap.parse_args()
+    if args.tenants:
+        main_tenants(args.nodes, args.heights, args.tenants)
+    else:
+        runner = main_chain if args.chain else main_async
+        asyncio.run(
+            runner(
+                args.nodes,
+                args.heights,
+                args.device,
+                args.bls,
+                args.mesh,
+                args.aggregate,
+            )
+        )

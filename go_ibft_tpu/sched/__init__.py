@@ -1,0 +1,34 @@
+"""Multi-tenant consensus: a process-wide verify scheduler (ISSUE 8).
+
+Many independent chains/validator sets multiplexed onto shared hardware:
+:class:`TenantScheduler` owns the device (or host-native) verify plane
+and coalesces lanes from N concurrent ``ChainRunner``s into shared
+batched dispatches, with deficit-round-robin fairness, per-chain
+backpressure, and per-tenant latency SLO evidence.  See docs/TENANCY.md.
+"""
+
+from .dispatch import CoalescedDispatcher
+from .scheduler import (
+    COALESCED_REQUESTS_KEY,
+    DISPATCHES_KEY,
+    DRAIN_MS_KEY,
+    FLUSH_FAULTS_KEY,
+    QUEUE_LANES_KEY,
+    SHED_LANES_KEY,
+    SchedQueueFull,
+    TenantScheduler,
+    TenantVerifierHandle,
+)
+
+__all__ = [
+    "CoalescedDispatcher",
+    "SchedQueueFull",
+    "TenantScheduler",
+    "TenantVerifierHandle",
+    "QUEUE_LANES_KEY",
+    "SHED_LANES_KEY",
+    "DISPATCHES_KEY",
+    "COALESCED_REQUESTS_KEY",
+    "DRAIN_MS_KEY",
+    "FLUSH_FAULTS_KEY",
+]

@@ -1,0 +1,310 @@
+"""The multi-tenant coalesced verify data plane.
+
+One flush of the :class:`~go_ibft_tpu.sched.scheduler.TenantScheduler`
+carries lanes from MANY chains — different validator sets, different
+proposal hashes, different heights — and must still land on the device as
+ONE batched dispatch.  The trick that makes cross-tenant coalescing exact
+is splitting the verification predicate at the membership check:
+
+* **signature validity is chain-agnostic** — ``recover(digest, sig) ==
+  claimed address`` does not mention a validator set, so lanes from any
+  number of chains share one recovery-ladder launch;
+* **membership is a host dict lookup** — ``claimed in
+  tenant.validators(height)`` is exact Python over the tenant's own
+  voting-power map, applied per lane after the shared mask returns.
+
+The device dispatch therefore runs the EXISTING pinned programs
+(:data:`DIGEST_KERNEL` / :data:`RECOVER_KERNEL` are the very jit objects
+``verify/batch.py`` compiled for the single-tenant plane — asserted by
+``scripts/compile_budget.py``, so the shared plane can never fork a new
+program family) with the membership table packed from the lanes' own
+claimed addresses: every live lane's claimed address is trivially a table
+member, which reduces the kernel's mask to pure signature validity.  The
+per-tenant membership AND happens on host, so the final verdict per lane
+is bit-identical to that tenant's sequential
+:class:`~go_ibft_tpu.verify.batch.HostBatchVerifier` oracle.
+
+The host route does the same split over the native bulk verifier (one
+GIL-releasing C call for the whole coalesced flush) or, without the
+native library, the pure-Python recover loop — the scheduler picks per
+flush exactly like :class:`~go_ibft_tpu.verify.batch.AdaptiveBatchVerifier`
+picks per drain (measured cutover, small flushes stay on host).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..crypto import ecdsa as host_ecdsa
+from ..crypto.keccak import keccak256_many
+from ..messages.helpers import CommittedSeal
+from ..messages.wire import IbftMessage
+from ..obs import trace
+from ..utils import metrics
+from ..verify import batch as vbatch
+from ..verify.batch import (
+    ADDRESS_BYTES,
+    SIG_BYTES,
+    pack_seal_lanes,
+    pack_validator_table,
+)
+from ..verify.pipeline import PackCache, SenderPack
+
+__all__ = [
+    "CoalescedDispatcher",
+    "DIGEST_KERNEL",
+    "RECOVER_KERNEL",
+    "DISPATCH_LANES_KEY",
+    "DISPATCH_MS_KEY",
+]
+
+# The shared dispatch MUST reuse the single-tenant plane's compiled
+# programs — these are the same jit objects, not re-jitted copies
+# (scripts/compile_budget.py asserts the identity so a refactor that
+# forks a new program family fails CI, and docs/compile_budget.json
+# gains no sched entries).
+DIGEST_KERNEL = vbatch._digest_kernel
+RECOVER_KERNEL = vbatch._recover_kernel
+
+DISPATCH_LANES_KEY = ("go-ibft", "sched", "dispatch_lanes")
+DISPATCH_MS_KEY = ("go-ibft", "sched", "dispatch_ms")
+
+class _RoutingPackCache:
+    """Store-side shim routing ``pack_sender_batch`` cache stores to each
+    message's OWN tenant cache.
+
+    A coalesced sender pack mixes messages from many tenants, but
+    ``pack_sender_batch`` takes one ``cache`` to store fresh packs into.
+    Lookups are supplied pre-routed (``cache_hits``); this shim routes the
+    stores by message identity so one tenant's packs can never land in —
+    or later be served from — another tenant's cache (the namespacing
+    contract of docs/TENANCY.md)."""
+
+    def __init__(self, owners: Dict[int, PackCache]):
+        self._owners = owners
+
+    def store(self, msg, pack: SenderPack) -> None:
+        owner = self._owners.get(id(msg))
+        if owner is not None:
+            owner.store(msg, pack)
+
+
+def well_formed_sender(msg: IbftMessage) -> bool:
+    """The oracle's sender-lane admission predicate
+    (:meth:`HostBatchVerifier.verify_senders` skip conditions)."""
+    return (
+        msg.view is not None
+        and len(msg.sender) == ADDRESS_BYTES
+        and len(msg.signature) == SIG_BYTES
+    )
+
+
+def well_formed_seal_lane(proposal_hash: bytes, seal: CommittedSeal) -> bool:
+    """The oracle's seal-lane admission predicate (hash + signer + sig)."""
+    return (
+        len(proposal_hash) == 32
+        and len(seal.signer) == ADDRESS_BYTES
+        and len(seal.signature) == SIG_BYTES
+    )
+
+
+class CoalescedDispatcher:
+    """One shared pack/dispatch engine for mixed-tenant lane batches.
+
+    :meth:`dispatch` takes pre-filtered (well-formed) sender messages and
+    ``(proposal_hash, seal)`` lanes from any mix of tenants and returns
+    one *signature-validity* mask per kind — ``recover(digest) ==
+    claimed``; membership is the caller's (per-tenant, host-exact).
+
+    ``route``:
+
+    * ``"auto"`` — host below the measured adaptive cutover (the same
+      calibration the :class:`AdaptiveBatchVerifier` uses: a handful of
+      lanes never pays a device dispatch floor), device at or above it;
+    * ``"host"`` / ``"device"`` — forced (bench variants, tests).
+    """
+
+    def __init__(self, route: str = "auto", cutover_lanes: Optional[int] = None):
+        if route not in ("auto", "host", "device"):
+            raise ValueError(f"unknown route {route!r}")
+        self.route = route
+        if cutover_lanes is None:
+            from ..utils import calibration
+
+            cutover_lanes = (
+                calibration.measured_cutover()
+                or calibration.DEFAULT_CUTOVER_LANES
+            )
+        self.cutover = cutover_lanes
+        # The recover programs compile per lane bucket; serialize warmup.
+        self._warm_lock = threading.Lock()
+
+    # -- public ----------------------------------------------------------
+
+    def warmup(self, lanes: Sequence[int] = (8,), table_rows: int = 8) -> None:
+        """Pre-compile the shared kernels (node startup; never mid-round)."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._warm_lock:
+            for bb in lanes:
+                RECOVER_KERNEL(
+                    jnp.zeros((bb, 8), jnp.uint32),
+                    jnp.zeros((bb, 20), jnp.int32),
+                    jnp.zeros((bb, 20), jnp.int32),
+                    jnp.zeros((bb,), jnp.int32),
+                    jnp.zeros((bb, 5), jnp.uint32),
+                    jnp.zeros((table_rows, 5), jnp.uint32),
+                    jnp.zeros((bb,), bool),
+                ).block_until_ready()
+                jax.block_until_ready(
+                    DIGEST_KERNEL(
+                        jnp.zeros((bb, 2, 17, 2), jnp.uint32),
+                        jnp.ones((bb,), jnp.int32),
+                    )
+                )
+
+    def dispatch(
+        self,
+        sender_msgs: Sequence[IbftMessage],
+        seal_lanes: Sequence[Tuple[bytes, CommittedSeal]],
+        pack_caches: Optional[Dict[int, PackCache]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Coalesced signature-validity masks for one flush.
+
+        ``pack_caches`` maps ``id(msg)`` to the owning tenant's
+        :class:`PackCache` (lookups AND stores are routed per message).
+        Returns ``(sender_sig_ok, seal_sig_ok)``; membership is NOT
+        included — the scheduler ANDs each lane with its own tenant's
+        validator set.
+        """
+        total = len(sender_msgs) + len(seal_lanes)
+        route = self.route
+        if route == "auto":
+            route = "device" if total >= self.cutover else "host"
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with trace.span(
+            "sched.dispatch",
+            route=route,
+            lanes=total,
+            senders=len(sender_msgs),
+            seals=len(seal_lanes),
+        ):
+            if route == "device":
+                out = self._device(sender_msgs, seal_lanes, pack_caches or {})
+            else:
+                out = self._host(sender_msgs, seal_lanes, pack_caches or {})
+        metrics.observe(DISPATCH_MS_KEY, (_time.perf_counter() - t0) * 1e3)
+        metrics.observe(DISPATCH_LANES_KEY, float(total))
+        return out
+
+    # -- device route ----------------------------------------------------
+
+    def _device(self, msgs, lanes, owners) -> Tuple[np.ndarray, np.ndarray]:
+        import jax.numpy as jnp
+
+        sender_ok = np.zeros(len(msgs), dtype=bool)
+        seal_ok = np.zeros(len(lanes), dtype=bool)
+        if msgs:
+            # The pack sequence (cache-hit reuse, oversize payloads
+            # digested on host) is the single-tenant plane's own
+            # implementation — shared, not forked, so a fix there can
+            # never miss this route.  Lookups are pre-routed per tenant;
+            # stores route back through the owners shim.
+            zw, r, s, v, claimed, live = vbatch.pack_sender_digest_rows(
+                msgs,
+                cache=_RoutingPackCache(owners),
+                hits=[
+                    (owners[id(m)].lookup(m) if id(m) in owners else None)
+                    for m in msgs
+                ],
+            )
+            # Claimed-address table: every live lane's claimed sender is a
+            # member by construction, so the kernel's (sig & member) mask
+            # reduces to signature validity — tenant membership stays on
+            # host where each chain's own set applies.
+            table = pack_validator_table(
+                list(dict.fromkeys(m.sender for m in msgs))
+            )
+            mask = RECOVER_KERNEL(
+                jnp.asarray(zw),
+                jnp.asarray(r),
+                jnp.asarray(s),
+                jnp.asarray(v),
+                jnp.asarray(claimed),
+                jnp.asarray(table),
+                jnp.asarray(live),
+            )
+            sender_ok = np.asarray(mask)[: len(msgs)]
+        if lanes:
+            hz, r, s, v, signers, live = pack_seal_lanes(list(lanes))
+            table = pack_validator_table(
+                list(dict.fromkeys(seal.signer for _h, seal in lanes))
+            )
+            mask = RECOVER_KERNEL(
+                jnp.asarray(hz),
+                jnp.asarray(r),
+                jnp.asarray(s),
+                jnp.asarray(v),
+                jnp.asarray(signers),
+                jnp.asarray(table),
+                jnp.asarray(live),
+            )
+            seal_ok = np.asarray(mask)[: len(lanes)]
+        return sender_ok, seal_ok
+
+    # -- host route ------------------------------------------------------
+
+    def _host(self, msgs, lanes, owners) -> Tuple[np.ndarray, np.ndarray]:
+        digests: List[bytes] = []
+        sigs: List[bytes] = []
+        claimed: List[bytes] = []
+        if msgs:
+            payloads = []
+            for m in msgs:
+                owner = owners.get(id(m))
+                hit = owner.lookup(m) if owner is not None else None
+                payloads.append(
+                    hit.payload
+                    if hit is not None
+                    else m.encode(include_signature=False)
+                )
+            digests.extend(keccak256_many(payloads))
+            sigs.extend(m.signature for m in msgs)
+            claimed.extend(m.sender for m in msgs)
+        for proposal_hash, seal in lanes:
+            digests.append(proposal_hash)
+            sigs.append(seal.signature)
+            claimed.append(seal.signer)
+        mask = self._host_sig_ok(digests, sigs, claimed)
+        return mask[: len(msgs)], mask[len(msgs) :]
+
+    @staticmethod
+    def _host_sig_ok(
+        digests: List[bytes], sigs: List[bytes], claimed: List[bytes]
+    ) -> np.ndarray:
+        if not digests:
+            return np.zeros(0, dtype=bool)
+        from .. import native
+
+        if native.load() is not None:
+            # One bulk GIL-releasing call; the claimed-set table makes the
+            # native membership check vacuous (recovered == claimed[i]
+            # implies membership), leaving exactly signature validity.
+            return native.verify_batch_sequential(
+                digests, sigs, claimed, list(dict.fromkeys(claimed))
+            )
+        out = np.zeros(len(digests), dtype=bool)
+        for i, (digest, sig, addr) in enumerate(zip(digests, sigs, claimed)):
+            r = int.from_bytes(sig[:32], "big")
+            s = int.from_bytes(sig[32:64], "big")
+            pub = host_ecdsa.recover(digest, r, s, sig[64])
+            out[i] = (
+                pub is not None and host_ecdsa.pubkey_to_address(*pub) == addr
+            )
+        return out

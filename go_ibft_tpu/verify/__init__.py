@@ -28,6 +28,7 @@ across backends is part of the conformance suite.
 from .batch import (
     AdaptiveBatchVerifier,
     DeviceBatchVerifier,
+    EngineScope,
     HostBatchVerifier,
     MalformedLaneError,
     ResilientBatchVerifier,
@@ -40,6 +41,7 @@ __all__ = [
     "AdaptiveBatchVerifier",
     "CircuitBreaker",
     "DeviceBatchVerifier",
+    "EngineScope",
     "HostBatchVerifier",
     "MalformedLaneError",
     "MeshBatchVerifier",

@@ -21,6 +21,7 @@ for committed seals.
 from __future__ import annotations
 
 import time
+from contextlib import ExitStack
 from typing import Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import jax
@@ -697,6 +698,73 @@ def _pack_seal_batch_reference(
     )
 
 
+# Largest payload the device digest path can absorb; one byte is reserved
+# for keccak padding in the last block.
+MAX_DEVICE_PAYLOAD = _BLOCK_BUCKETS[-1] * dk.RATE_BYTES - 1
+
+
+def pack_sender_digest_rows(
+    msgs: Sequence[IbftMessage],
+    *,
+    cache=None,
+    hits: Optional[list] = None,
+    pad_lanes: int = 0,
+):
+    """The device sender-route pack sequence: cache-hit reuse, oversize
+    payloads digested on host, everything else on the device digest
+    kernel.
+
+    A payload above the largest keccak block bucket (a PREPREPARE
+    carrying a round-change certificate easily is) must NOT crash the
+    packer — r05 observed exactly that taking a cluster down when a
+    round change produced a 57-block proposal.  Such lanes get their
+    digest from the (native) host keccak, injected into the ``zw`` rows;
+    the expensive part — the recovery ladder — still runs on device for
+    every lane.
+
+    ONE implementation serves both the single-tenant plane
+    (:meth:`DeviceBatchVerifier._sender_inputs_impl`) and the
+    multi-tenant coalesced dispatcher (``sched/dispatch.py``), so a fix
+    to the oversize/cache path can never apply to one and silently miss
+    the other.  ``cache`` is the store target for fresh packs (a
+    :class:`PackCache`, or the scheduler's per-tenant routing shim);
+    ``hits`` supplies pre-routed lookups (computed from ``cache`` when
+    omitted).  Returns ``(zw, r, s, v, senders, live)``.
+    """
+    if hits is None:
+        hits = (
+            [cache.lookup(m) for m in msgs]
+            if cache is not None
+            else [None] * len(msgs)
+        )
+    payloads = [
+        h.payload if h is not None else m.encode(include_signature=False)
+        for h, m in zip(hits, msgs)
+    ]
+    big = [i for i, p in enumerate(payloads) if len(p) > MAX_DEVICE_PAYLOAD]
+    if big:
+        device_payloads = list(payloads)
+        for i in big:
+            device_payloads[i] = b""
+    else:
+        device_payloads = payloads
+    blocks, counts, r, s, v, senders, live = pack_sender_batch(
+        msgs,
+        pad_lanes=pad_lanes,
+        payloads=device_payloads,
+        cache=cache,
+        cache_payloads=payloads,
+        cache_hits=hits,
+    )
+    zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
+    if big:
+        zw = np.array(zw)  # writable host copy (np.asarray can be RO)
+        digests = keccak256_many([payloads[i] for i in big])
+        for i, digest in zip(big, digests):
+            zw[i] = np.frombuffer(digest, ">u4")[::-1].astype(np.uint32)
+    return zw, r, s, v, senders, live
+
+
 class DeviceBatchVerifier:
     """One ``jit`` batch per phase on the active JAX backend.
 
@@ -744,6 +812,15 @@ class DeviceBatchVerifier:
     def reset_pack_cache(self) -> None:
         """Engine hook: new sequence -> drop all cached packs."""
         self._pack_cache.clear()
+
+    def _pack_caches(self) -> List["PackCache"]:
+        """The lifecycle-scoped caches this verifier owns (EngineScope)."""
+        return [self._pack_cache]
+
+    def scoped(self, owner: str) -> "EngineScope":
+        """A per-engine lifecycle facade for SHARING this verifier across
+        engines: see :class:`EngineScope`."""
+        return EngineScope(self, owner)
 
     def quarantine(self, msgs: Sequence[IbftMessage]) -> None:
         """Degraded-mode hook: lanes condemned by a quarantining drain.
@@ -968,7 +1045,7 @@ class DeviceBatchVerifier:
 
     # Largest payload the device digest path can absorb; one byte is
     # reserved for keccak padding in the last block.
-    _MAX_DEVICE_PAYLOAD = _BLOCK_BUCKETS[-1] * dk.RATE_BYTES - 1
+    _MAX_DEVICE_PAYLOAD = MAX_DEVICE_PAYLOAD
 
     def _sender_inputs(self, msgs: List[IbftMessage], pad_lanes: int = 0):
         pad_lanes = max(pad_lanes, self._pad_lanes(len(msgs)))
@@ -978,50 +1055,22 @@ class DeviceBatchVerifier:
     def _sender_inputs_impl(self, msgs: List[IbftMessage], pad_lanes: int = 0):
         """Pack envelopes; digest on device, oversize payloads on host.
 
-        A payload above the largest keccak block bucket (a PREPREPARE
-        carrying a round-change certificate easily is) must NOT crash the
-        packer — r05 observed exactly that taking a cluster down when a
-        round change produced a 57-block proposal.  Such lanes get their
-        digest from the (native) host keccak, injected into the ``zw``
-        rows; the expensive part — the recovery ladder — still runs on
-        device for every lane.  Serves both the per-phase dispatches and
-        (via ``pad_lanes``) the single-dispatch ``certify_round`` packing.
-
         Payload encodings and limb rows come from the pack cache when this
         engine already packed the message (certificate re-validation runs
         per round-change wakeup over the same envelopes); fresh lanes pack
-        vectorized and store back.
+        vectorized and store back.  Serves both the per-phase dispatches
+        and (via ``pad_lanes``) the single-dispatch ``certify_round``
+        packing; the sequence itself lives in
+        :func:`pack_sender_digest_rows` (shared with the multi-tenant
+        coalesced dispatcher).
         """
         cache = self._pack_cache
-        hits = [cache.lookup(m) for m in msgs]
-        payloads = [
-            h.payload if h is not None else m.encode(include_signature=False)
-            for h, m in zip(hits, msgs)
-        ]
-        big = [
-            i for i, p in enumerate(payloads) if len(p) > self._MAX_DEVICE_PAYLOAD
-        ]
-        if big:
-            device_payloads = list(payloads)
-            for i in big:
-                device_payloads[i] = b""
-        else:
-            device_payloads = payloads
-        blocks, counts, r, s, v, senders, live = pack_sender_batch(
+        return pack_sender_digest_rows(
             msgs,
-            pad_lanes=pad_lanes,
-            payloads=device_payloads,
             cache=cache,
-            cache_payloads=payloads,
-            cache_hits=hits,
+            hits=[cache.lookup(m) for m in msgs],
+            pad_lanes=pad_lanes,
         )
-        zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
-        if big:
-            zw = np.array(zw)  # writable host copy (np.asarray can be RO)
-            digests = keccak256_many([payloads[i] for i in big])
-            for i, digest in zip(big, digests):
-                zw[i] = np.frombuffer(digest, ">u4")[::-1].astype(np.uint32)
-        return zw, r, s, v, senders, live
 
     def _seal_inputs(
         self, proposal_hash: bytes, seals: List[CommittedSeal], pad_lanes: int = 0
@@ -1511,6 +1560,19 @@ class ResilientBatchVerifier:
             if hasattr(rung, "reset_pack_cache"):
                 rung.reset_pack_cache()
 
+    def _pack_caches(self) -> List["PackCache"]:
+        return [
+            cache
+            for rung in self._fast_rungs()
+            if hasattr(rung, "_pack_caches")
+            for cache in rung._pack_caches()
+        ]
+
+    def scoped(self, owner: str) -> "EngineScope":
+        """Per-engine lifecycle facade over the shared ladder (the whole
+        rung stack stays shared; only round/sequence state splits)."""
+        return EngineScope(self, owner)
+
     # -- BatchVerifier ---------------------------------------------------
 
     def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
@@ -1739,6 +1801,13 @@ class AdaptiveBatchVerifier:
 
     def reset_pack_cache(self) -> None:
         self._resilient.reset_pack_cache()
+
+    def _pack_caches(self) -> List["PackCache"]:
+        return self._resilient._pack_caches()
+
+    def scoped(self, owner: str) -> "EngineScope":
+        """Per-engine lifecycle facade over the shared adaptive router."""
+        return EngineScope(self, owner)
 
     # -- host-side quorum (exact big ints) ------------------------------
 
@@ -2052,3 +2121,81 @@ class AdaptiveBatchVerifier:
             # probe as still pending and cannot double-acquire it.
             self.breaker.abort_probe(fallback_level)
         return sender_mask, p_ok, seal_mask, s_ok
+
+
+class EngineScope:
+    """Per-engine lifecycle facade over a SHARED verifier ladder.
+
+    N engines (one per chain/tenant) may share one verifier so their
+    drains land on one device data plane, but the engine lifecycle hooks
+    carry per-sequence/per-round state: before this scope existed the
+    ladder-wide reset assumed a single engine — engine A's
+    ``reset_pack_cache()`` (sequence start) wiped engine B's live packs,
+    and A's ``note_round(0)`` retagged the shared cache's live round out
+    from under B's entries, demoting them to dead-round eviction fodder
+    mid-round (ISSUE 8 satellite).
+
+    ``ladder.scoped("chain-a")`` returns a drop-in ``BatchVerifier``
+    whose verify calls attribute their pack-cache stores to the owner
+    (:meth:`PackCache.owned`) and whose ``note_round`` /
+    ``reset_pack_cache`` rotate/drop ONLY the owner's entries; every
+    other attribute (``quarantine`` — already per-message — ``warmup``,
+    the certify surface, breaker state) delegates to the shared parent.
+    The :class:`~go_ibft_tpu.sched.TenantScheduler`'s handles are the
+    fully-managed version of this (per-tenant queues, fairness and
+    backpressure on top); a bare shared ladder with scopes is the
+    minimal-correct one.
+    """
+
+    def __init__(self, parent, owner: str):
+        if not owner:
+            raise ValueError("EngineScope requires a non-empty owner label")
+        self._parent = parent
+        self._owner = owner
+
+    @property
+    def owner(self) -> str:
+        return self._owner
+
+    def __getattr__(self, name: str):
+        return getattr(self._parent, name)
+
+    def _caches(self) -> List["PackCache"]:
+        fn = getattr(self._parent, "_pack_caches", None)
+        return fn() if fn is not None else []
+
+    def _owned(self) -> ExitStack:
+        stack = ExitStack()
+        for cache in self._caches():
+            stack.enter_context(cache.owned(self._owner))
+        return stack
+
+    # -- owner-scoped engine lifecycle hooks -----------------------------
+
+    def note_round(self, round_: int) -> None:
+        for cache in self._caches():
+            cache.note_round(round_, owner=self._owner)
+
+    def reset_pack_cache(self) -> None:
+        for cache in self._caches():
+            cache.clear(owner=self._owner)
+
+    # -- BatchVerifier (stores attributed to the owner) ------------------
+
+    def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
+        with self._owned():
+            return self._parent.verify_senders(msgs)
+
+    def verify_committed_seals(
+        self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
+    ) -> np.ndarray:
+        with self._owned():
+            return self._parent.verify_committed_seals(
+                proposal_hash, seals, height
+            )
+
+    def verify_seal_lanes(
+        self, lanes: Sequence[Tuple[bytes, CommittedSeal]], height: int
+    ) -> np.ndarray:
+        with self._owned():
+            return self._parent.verify_seal_lanes(lanes, height)

@@ -45,6 +45,7 @@ import threading
 import time
 import weakref
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -334,32 +335,80 @@ class PackCache:
 
     Eviction mirrors the engine's seal-verdict cache: entries are tagged
     with the round current at pack time (``note_round``); on cap pressure
-    whole dead rounds evict before the live round gives up anything, and
-    within the live round eviction is FIFO.  ``clear()`` runs per sequence.
+    whole dead rounds evict before any live round gives up anything, and
+    within a live round eviction is FIFO.  ``clear()`` runs per sequence.
     Thread-safe (ingress may pack from transport threads).
+
+    **Owner scoping (ISSUE 8 satellite).**  A cache shared by several
+    engines (one ladder serving N chains) must not let one engine's
+    lifecycle rotate or reset another's live state: every entry is tagged
+    with an *owner* — the thread-local label installed by
+    :meth:`owned` while a scoped verify call packs — and ``note_round``
+    / ``clear`` take an optional ``owner`` so a rotation retags, and a
+    sequence reset drops, ONLY that owner's entries.  The legacy
+    single-engine calls (no owner) keep their process-wide meaning: the
+    default owner is ``""`` for ``note_round``, and an ownerless
+    ``clear()`` still wipes everything (the sole-owner posture).  Each
+    owner's live round is protected from cap-pressure eviction
+    independently; dead rounds of any owner evict first, oldest round
+    first.
     """
 
     def __init__(self, cap: int = 8192):
         self._lock = threading.RLock()
-        self._by_round: Dict[int, Dict[int, Tuple[Any, Tuple[bytes, bytes], SenderPack]]] = {}
-        self._index: Dict[int, int] = {}  # id(msg) -> round tag
+        # (owner, round) -> {id(msg) -> (weakref, token, pack)}
+        self._by_round: Dict[
+            Tuple[str, int],
+            Dict[int, Tuple[Any, Tuple[bytes, bytes], SenderPack]],
+        ] = {}
+        self._index: Dict[int, Tuple[str, int]] = {}  # id(msg) -> tag
         self._count = 0
-        self._round = 0
+        self._rounds: Dict[str, int] = {"": 0}  # owner -> live round
+        self._tl = threading.local()
         self._cap = cap
         self.hits = 0
         self.misses = 0
 
-    def note_round(self, round_: int) -> None:
-        """Tag subsequent stores with ``round_`` (engine round advances)."""
+    @property
+    def _round(self) -> int:
+        """Default owner's live round (single-engine posture)."""
         with self._lock:
-            self._round = round_
+            return self._rounds.get("", 0)
 
-    def clear(self) -> None:
+    @contextmanager
+    def owned(self, owner: str):
+        """Attribute stores on THIS thread to ``owner`` while active (the
+        :class:`~go_ibft_tpu.verify.batch.EngineScope` verify wrapper)."""
+        prev = getattr(self._tl, "owner", "")
+        self._tl.owner = owner
+        try:
+            yield self
+        finally:
+            self._tl.owner = prev
+
+    def note_round(self, round_: int, owner: str = "") -> None:
+        """Tag ``owner``'s subsequent stores with ``round_`` (engine round
+        advances).  Only that owner's eviction ordering moves."""
         with self._lock:
-            self._by_round.clear()
-            self._index.clear()
-            self._count = 0
-            self._round = 0
+            self._rounds[owner] = round_
+
+    def clear(self, owner: Optional[str] = None) -> None:
+        """Drop cached packs: all of them (``owner=None`` — the
+        single-engine sequence reset) or one owner's only."""
+        with self._lock:
+            if owner is None:
+                self._by_round.clear()
+                self._index.clear()
+                self._count = 0
+                self._rounds = {"": 0}
+                return
+            for tag in [t for t in self._by_round if t[0] == owner]:
+                bucket = self._by_round.pop(tag)
+                for mid in bucket:
+                    del self._index[mid]
+                self._count -= len(bucket)
+            self._rounds.pop(owner, None)
+            self._rounds.setdefault("", 0)
 
     def __len__(self) -> int:
         with self._lock:
@@ -389,12 +438,15 @@ class PackCache:
             return
         with self._lock:
             self._remove(mid)
-            self._by_round.setdefault(self._round, {})[mid] = (
+            owner = getattr(self._tl, "owner", "")
+            self._rounds.setdefault(owner, 0)
+            tag = (owner, self._rounds[owner])
+            self._by_round.setdefault(tag, {})[mid] = (
                 wref,
                 (msg.sender, msg.signature),
                 pack,
             )
-            self._index[mid] = self._round
+            self._index[mid] = tag
             self._count += 1
             self._evict()
 
@@ -428,9 +480,15 @@ class PackCache:
 
     def _evict(self) -> None:
         while self._count > self._cap and self._by_round:
-            oldest = min(self._by_round)
+            # EVERY owner's live round is protected equally: dead rounds
+            # (any owner, oldest round first) evict whole; only when no
+            # dead round remains does the oldest live round shed FIFO.
+            live = {(o, r) for o, r in self._rounds.items()}
+            dead = [t for t in self._by_round if t not in live]
+            pool = dead if dead else list(self._by_round)
+            oldest = min(pool, key=lambda t: (t[1], t[0]))
             bucket = self._by_round[oldest]
-            if oldest == self._round:
+            if not dead:
                 mid = next(iter(bucket))
                 del bucket[mid]
                 del self._index[mid]

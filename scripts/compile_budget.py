@@ -134,6 +134,39 @@ def _programs() -> dict:
     return out
 
 
+def _check_sched_reuses_pinned_programs() -> list:
+    """ISSUE 8 satellite: the multi-tenant coalesced dispatch
+    (go_ibft_tpu/sched/dispatch.py) must run the EXISTING pinned jit
+    programs — the very objects verify/batch.py compiled — so process-
+    wide coalescing adds NO new program family to this budget (the
+    snapshot gains no sched entries by construction).  A refactor that
+    re-jits a private copy forks a second compile of the largest
+    recover ladder; assert object identity so that fails CI here."""
+    from go_ibft_tpu.sched import dispatch as sched_dispatch
+    from go_ibft_tpu.verify import batch as vbatch
+
+    failures = []
+    if sched_dispatch.DIGEST_KERNEL is not vbatch._digest_kernel:
+        failures.append(
+            "sched.dispatch.DIGEST_KERNEL is not verify.batch._digest_kernel "
+            "— the coalesced plane forked a second digest program"
+        )
+    if sched_dispatch.RECOVER_KERNEL is not vbatch._recover_kernel:
+        failures.append(
+            "sched.dispatch.RECOVER_KERNEL is not verify.batch._recover_kernel "
+            "— the coalesced plane forked a second recover program"
+        )
+    print(
+        json.dumps(
+            {
+                "check": "sched_reuses_pinned_programs",
+                "status": "FAIL" if failures else "ok",
+            }
+        )
+    )
+    return failures
+
+
 def main() -> int:
     import jax
 
@@ -146,6 +179,13 @@ def main() -> int:
         SNAPSHOT.write_text(json.dumps(measured, indent=1) + "\n")
         print(json.dumps({"compile_budget": "snapshot written", **measured}))
         return 0
+
+    identity_failures = _check_sched_reuses_pinned_programs()
+    if identity_failures:
+        print(
+            json.dumps({"compile_budget": "FAIL", "failures": identity_failures})
+        )
+        return 1
 
     snapshot = json.loads(SNAPSHOT.read_text())
     if snapshot.get("_jax_version") != jax.__version__:

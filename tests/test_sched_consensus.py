@@ -1,0 +1,260 @@
+"""Multi-tenant consensus under seeded chaos (ISSUE 8, satellite 3).
+
+The fairness/backpressure acceptance at consensus level: a HOT tenant
+driving 100-validator-scale verify drains through the process-wide
+scheduler must not starve a SLOW 4-validator chain out of height
+progress (and vice versa), under a seeded chaos schedule on the chain's
+message deliveries.
+
+* tier-1 smoke — one real-crypto 4-validator ChainRunner cluster
+  (seeded chaos drops/delays/duplicates) shares the scheduler with a hot
+  tenant flooding 100-validator seal-lane drains from another thread;
+  the chain must finalize every height, every hot drain must stay
+  bit-identical to the sequential oracle, and the two loads must have
+  actually coalesced into shared dispatches.
+* slow soak — TWO real chains (a 7-node hot chain under a
+  duplicate-heavy schedule and a 4-node slow chain) run concurrently in
+  separate event-loop threads plus the 100-validator flood; every chain
+  finalizes every height (no tenant starved — the config #10 acceptance
+  posture).
+
+Failures print the CHAOS-REPLAY artifact line like every chaos suite.
+"""
+
+import asyncio
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.bench.workload import build_seal_lane_workload
+from go_ibft_tpu.chain import (
+    ChainRunner,
+    LoopbackSyncNetwork,
+    SyncClient,
+    WriteAheadLog,
+)
+from go_ibft_tpu.chaos import (
+    ChaoticDeliver,
+    FaultConfig,
+    FaultInjector,
+    replay_on_failure,
+)
+from go_ibft_tpu.core import IBFT, BatchingIngress
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend
+from go_ibft_tpu.sched import TenantScheduler
+from go_ibft_tpu.verify import HostBatchVerifier
+
+from harness import NullLogger
+
+# Same quorum-budget posture as tests/test_chaos.py: combined loss well
+# under the 1/3 fault budget so the soak measures robustness, not luck.
+_CFG = FaultConfig(
+    drop_rate=0.02,
+    delay_rate=0.2,
+    max_delay_s=0.01,
+    duplicate_rate=0.05,
+    reorder_rate=0.05,
+)
+# The hot chain's schedule is duplicate-heavy: more deliveries = more
+# ingress drains = more scheduler traffic from the hot tenant.
+_HOT_CFG = FaultConfig(
+    drop_rate=0.02,
+    delay_rate=0.2,
+    max_delay_s=0.005,
+    duplicate_rate=0.25,
+    reorder_rate=0.1,
+)
+
+
+class _SchedChainCluster:
+    """N ChainRunner nodes whose engines verify through scheduler handles."""
+
+    def __init__(
+        self, tmp_path, chain_id, n, injector, sched, *, timeout=1.0
+    ):
+        self.keys = [
+            PrivateKey.from_seed(b"mt-%s-%d" % (chain_id.encode(), i))
+            for i in range(n)
+        ]
+        self.src = ECDSABackend.static_validators(
+            {k.address: 1 for k in self.keys}
+        )
+        self.net = LoopbackSyncNetwork()
+        self.nodes = []
+        self.runners = []
+        self._gates = []
+        cluster = self
+
+        class _T:
+            def multicast(self, message):
+                for gate in cluster._gates:
+                    gate(message)
+
+        for i, key in enumerate(self.keys):
+            handle = sched.register(
+                f"{chain_id}/n{i}", self.src, chain_id=chain_id
+            )
+            core = IBFT(
+                NullLogger(),
+                ECDSABackend(key, self.src),
+                _T(),
+                batch_verifier=handle,
+            )
+            core.set_base_round_timeout(timeout)
+            ingress = BatchingIngress(core.add_messages)
+            self._gates.append(
+                ChaoticDeliver(
+                    ingress.submit, injector, f"{chain_id}-deliver:{i}"
+                )
+            )
+            self.nodes.append((core, ingress))
+            runner = ChainRunner(
+                core,
+                WriteAheadLog(
+                    os.path.join(str(tmp_path), f"{chain_id}-wal-{i}.jsonl")
+                ),
+                sync=SyncClient(key.address, self.net, handle, self.src),
+            )
+            self.net.register(key.address, runner)
+            self.runners.append(runner)
+
+    def close(self):
+        for core, ingress in self.nodes:
+            ingress.close()
+            core.messages.close()
+
+
+async def _drive_chain(tmp_path, chain_id, n, heights, injector, sched, deadline):
+    cluster = _SchedChainCluster(tmp_path, chain_id, n, injector, sched)
+    try:
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(r.run(until_height=heights) for r in cluster.runners)
+            ),
+            deadline,
+        )
+        chains = [
+            [b.proposal.raw_proposal for b in r.chain]
+            for r in cluster.runners
+        ]
+        assert all(len(c) == heights for c in chains), [len(c) for c in chains]
+        assert all(c == chains[0] for c in chains), "chains diverged"
+    finally:
+        cluster.close()
+        await asyncio.sleep(0.03)  # let chaotic call_later deliveries land
+
+
+class _HotFlood:
+    """Hot tenant: 100-validator seal-lane drains from a worker thread."""
+
+    def __init__(self, sched, lanes=256):
+        self.workload = build_seal_lane_workload(
+            lanes, n_validators=100, heights=2, corrupt_frac=0.1, seed=5
+        )
+        self.handle = sched.register(
+            "hot100", self.workload.validators, chain_id="hot100"
+        )
+        self.stop = threading.Event()
+        self.drains = 0
+        self.mismatches = 0
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        w = self.workload
+        while not self.stop.is_set():
+            mask = self.handle.verify_seal_lanes(w.lanes, w.height)
+            self.drains += 1
+            if not (mask == w.expected_mask).all():
+                self.mismatches += 1
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop.set()
+        self.thread.join(30.0)
+        assert not self.thread.is_alive()
+
+
+def test_sched_chaos_smoke_hot_and_slow_tenant(tmp_path):
+    """Tier-1: hot 100v drains + a chaotic 4v chain share one scheduler;
+    both make progress, hot verdicts stay oracle-exact, loads coalesce."""
+    injector = FaultInjector(1337, _CFG)
+    sched = TenantScheduler(window_s=0.001, route="host")
+    with replay_on_failure(injector):
+        with sched:
+            with _HotFlood(sched) as flood:
+                asyncio.run(
+                    _drive_chain(
+                        tmp_path, "slow4", 4, 3, injector, sched, 60.0
+                    )
+                )
+            assert flood.drains > 0, "hot tenant made no progress"
+            assert flood.mismatches == 0, (
+                f"{flood.mismatches}/{flood.drains} hot drains diverged "
+                "from the sequential oracle"
+            )
+    stats = sched.stats()
+    assert stats["flush_faults"] == 0, stats
+    assert stats["coalesce_ratio"] is not None and stats["coalesce_ratio"] >= 1.0
+    hot = stats["tenants"]["hot100"]
+    assert hot["drain_p99_ms"] is not None
+    # the chain's tenants were all served too (no starvation)
+    chain_lanes = sum(
+        t["lanes"] + t["shed_lanes"]
+        for tid, t in stats["tenants"].items()
+        if t["chain"] == "slow4"
+    )
+    assert chain_lanes > 0
+
+
+@pytest.mark.slow
+def test_sched_soak_two_chains_plus_flood(tmp_path):
+    """Slow soak: a duplicate-heavy 7-node hot chain and a 4-node slow
+    chain run CONCURRENTLY (own event-loop threads) against one
+    scheduler, plus the 100v flood — every chain finalizes every height
+    under its seeded schedule (the no-tenant-starved acceptance)."""
+    heights = 6
+    sched = TenantScheduler(window_s=0.001, route="host")
+    hot_inj = FaultInjector(2024, _HOT_CFG)
+    slow_inj = FaultInjector(4099, _CFG)
+    errors = []
+
+    def chain_thread(chain_id, n, injector, deadline):
+        try:
+            asyncio.run(
+                _drive_chain(
+                    tmp_path, chain_id, n, heights, injector, sched, deadline
+                )
+            )
+        except BaseException as err:  # noqa: BLE001 - surfaced in main
+            errors.append((chain_id, err))
+
+    with replay_on_failure(hot_inj), replay_on_failure(slow_inj):
+        with sched:
+            with _HotFlood(sched) as flood:
+                threads = [
+                    threading.Thread(
+                        target=chain_thread, args=("hot7", 7, hot_inj, 180.0)
+                    ),
+                    threading.Thread(
+                        target=chain_thread, args=("slow4", 4, slow_inj, 180.0)
+                    ),
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(200.0)
+                    assert not t.is_alive(), "chain thread wedged"
+            assert not errors, errors
+            assert flood.mismatches == 0
+            assert flood.drains > 0
+    stats = sched.stats()
+    assert stats["flush_faults"] == 0, stats
+    # both chains' tenants and the flood all flowed through ONE plane
+    chains_seen = {t["chain"] for t in stats["tenants"].values()}
+    assert {"hot7", "slow4", "hot100"} <= chains_seen

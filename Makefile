@@ -2,7 +2,7 @@
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
-	ab-keccak tenant-bench sched-soak
+	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -48,15 +48,28 @@ tenant-bench:
 	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=900 \
 	python bench.py --tenant-only
 
+# Commit-critical-path latency smoke (config #11): proposal-accept ->
+# finalize p50/p99 at 100 validators on the host route, speculation +
+# early-exit ON vs OFF under a byte-identical lagging-replica arrival
+# schedule.  Fast-tier CI entry; verdicts oracle-gated per height.
+latency-smoke:
+	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --latency-only
+
 # Multi-tenant fairness soak: hot + slow chains sharing one scheduler
 # under seeded chaos (tests/test_sched_consensus.py, slow tier included)
 sched-soak:
 	python -m pytest tests/test_sched.py tests/test_sched_consensus.py -q
 
 # Stablehlo-line budgets for the hot programs, incl. the mesh program at
-# dp=2/4/8 (trace size IS cold-compile time on XLA:CPU)
+# dp=2/4/8 (trace size IS cold-compile time on XLA:CPU).  CI runs the
+# --check ratchet (>2% growth fails); the bare target keeps 10% local
+# slack.
 compile-budget:
 	python scripts/compile_budget.py
+
+compile-budget-check:
+	python scripts/compile_budget.py --check
 
 # Pallas keccak A/B in CI's forced-host mode: interpret-mode execution +
 # bit-exact parity vs the XLA route (skips with reason when Pallas is

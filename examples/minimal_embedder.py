@@ -45,6 +45,7 @@ def build_cluster(
     use_bls: bool = False,
     use_mesh: bool = False,
     use_aggregate: bool = False,
+    use_speculate: bool = False,
 ):
     # 1. Validator identities and the (static) voting-power map.
     keys = [PrivateKey.from_seed(b"example-validator-%d" % i) for i in range(n)]
@@ -138,12 +139,28 @@ def build_cluster(
                 batch_verifier = HybridBatchVerifier(
                     batch_verifier, BLSAggregateVerifier(bls_src)
                 )
+        speculator = None
+        if use_speculate:
+            # Commit-critical-path posture (ISSUE 9): COMMIT seals
+            # arriving ahead of their phase verify off the event loop
+            # through the engine's own verifier, and the commit drain
+            # early-exits at quorum (on by default), deferring the
+            # remainder to the same worker.
+            from go_ibft_tpu.verify import HostBatchVerifier as _HBV
+            from go_ibft_tpu.verify import SpeculativeVerifier
+
+            speculator = SpeculativeVerifier(
+                batch_verifier
+                if batch_verifier is not None
+                else _HBV(validators)
+            )
         engine = IBFT(
             StdoutLogger(),
             backend,
             transport,
             batch_verifier=batch_verifier,
             cert_verifier=certifier,
+            speculator=speculator,
         )
         engine.set_base_round_timeout(10.0)
         if hub is not None:
@@ -163,9 +180,10 @@ async def main_async(
     use_bls: bool = False,
     use_mesh: bool = False,
     use_aggregate: bool = False,
+    use_speculate: bool = False,
 ) -> None:
     engines, _certifier, hub = build_cluster(
-        n, use_device, use_bls, use_mesh, use_aggregate
+        n, use_device, use_bls, use_mesh, use_aggregate, use_speculate
     )
     if hub is not None:
         hub.start()
@@ -179,8 +197,16 @@ async def main_async(
             await hub.stop()
         for e in engines:
             e.messages.close()
+            if e.speculator is not None:
+                e.speculator.stop()
 
     _print_chains(engines)
+    if use_speculate:
+        stats = engines[0].speculator.stats()
+        print(
+            f"speculation: {stats['speculated_lanes']} lanes off-path, "
+            f"{stats['cache_hits']} drain cache hits"
+        )
     if hub is not None:
         stats = hub.stats()
         print(
@@ -196,6 +222,7 @@ async def main_chain(
     use_bls: bool = False,
     use_mesh: bool = False,
     use_aggregate: bool = False,
+    use_speculate: bool = False,
 ) -> None:
     """The continuous-node mode: one ChainRunner per validator.
 
@@ -220,7 +247,7 @@ async def main_chain(
     from go_ibft_tpu.verify import HostBatchVerifier
 
     engines, certifier, hub = build_cluster(
-        n, use_device, use_bls, use_mesh, use_aggregate
+        n, use_device, use_bls, use_mesh, use_aggregate, use_speculate
     )
     network = LoopbackSyncNetwork()
     runners = []
@@ -404,6 +431,13 @@ if __name__ == "__main__":
         "WAL/sync carry certificates instead of per-validator seals",
     )
     ap.add_argument(
+        "--speculate",
+        action="store_true",
+        help="speculative cross-phase verification: COMMIT seals verify "
+        "off the event loop as they arrive (before their phase opens) "
+        "and the commit drain early-exits at quorum (ISSUE 9)",
+    )
+    ap.add_argument(
         "--chain",
         action="store_true",
         help="drive heights through ChainRunners (persistent per-node "
@@ -433,5 +467,6 @@ if __name__ == "__main__":
                 args.bls,
                 args.mesh,
                 args.aggregate,
+                args.speculate,
             )
         )

@@ -73,6 +73,7 @@ class ChainRunner:
         *,
         sync: Optional[SyncClient] = None,
         certifier=None,
+        speculator=None,
         overlap: bool = True,
         overlap_poll_s: float = 0.002,
         max_chain_blocks: int = 8192,
@@ -92,6 +93,17 @@ class ChainRunner:
         # quorum formed).  Peers then serve certificate blocks and the
         # sync client re-verifies each height with ONE pairing.
         self.certifier = certifier
+        # Speculative verification plane (ISSUE 9): attaching a
+        # :class:`~go_ibft_tpu.verify.speculate.SpeculativeVerifier`
+        # here wires it into the engine — ingress COMMIT seals verify
+        # off the event loop as they land (including the future-height
+        # COMMITs the overlap worker hands over via
+        # ``add_verified_messages``), and the COMMIT drain's early-exit
+        # remainder resolves through the same worker.  The engine owns
+        # the lifecycle hooks; the runner only surfaces the evidence
+        # (``stats()["speculation"]``).
+        if speculator is not None:
+            engine.speculator = speculator
         self.overlap = overlap
         self._overlap_poll_s = overlap_poll_s
         self._sync_poll_s = sync_poll_s
@@ -568,6 +580,7 @@ class ChainRunner:
     def stats(self) -> dict:
         """Bench/evidence snapshot (config #7 reads this)."""
         n = len(self.handoff_ms)
+        speculator = getattr(self.engine, "speculator", None)
         return {
             "heights_run": self.heights_run,
             "synced_heights": self.synced_heights,
@@ -576,4 +589,7 @@ class ChainRunner:
             "handoff_ms_mean": (sum(self.handoff_ms) / n) if n else None,
             "handoff_ms_max": max(self.handoff_ms) if n else None,
             "chain_height": self.latest_height(),
+            "speculation": (
+                speculator.stats() if speculator is not None else None
+            ),
         }

@@ -144,6 +144,8 @@ class IBFT:
         message_store: Optional[MessageStore] = None,
         batch_verifier: Optional[BatchVerifier] = None,
         cert_verifier=None,
+        speculator=None,
+        commit_early_exit: bool = True,
     ) -> None:
         self.log = logger
         self.backend = backend
@@ -227,6 +229,24 @@ class IBFT:
         self._cert_lock = threading.Lock()
         self._pending_certs: dict[int, object] = {}
         self.finalized_certificate = None
+        # Speculative cross-phase verification (ISSUE 9): ``speculator``
+        # (a :class:`~go_ibft_tpu.verify.speculate.SpeculativeVerifier`)
+        # verifies COMMIT seals OFF the event loop as they land at
+        # ingress — seal validity is proposal-independent (the digest is
+        # the hash carried IN the message), so nothing about it needs
+        # the COMMIT phase to be open.  The drain then consults the
+        # speculation cache (full binding: height, round, carried hash,
+        # sender, signature — a verdict can never leak across a
+        # different binding) before dispatching fresh crypto.  Opt-in,
+        # like cert_verifier: the embedder decides whether a background
+        # verify thread exists.
+        self.speculator = speculator
+        # Incremental quorum early-exit (ISSUE 9): the COMMIT drain
+        # stops verifying at the exact voting-power quorum and hands the
+        # unverified remainder to the speculator for lazy off-path
+        # resolution (or resolves it synchronously if the early exit
+        # mispredicted — liveness never depends on a deferred lane).
+        self.commit_early_exit = commit_early_exit
         # Chain-layer hooks (go_ibft_tpu.chain): on_lock fires when a
         # prepare quorum pins the PC (the WAL's in-flight lock record);
         # on_finalize fires after insert_proposal and BEFORE the store
@@ -290,6 +310,11 @@ class IBFT:
             bv.reset_pack_cache()
         if hasattr(bv, "note_round"):
             bv.note_round(0)
+        if self.speculator is not None:
+            # Pin the live view; verdicts speculated for FUTURE heights
+            # survive (that early traffic is the whole point), stale
+            # heights drop.
+            self.speculator.note_view(height, 0)
 
         try:
             self.validator_manager.init(height)
@@ -888,10 +913,19 @@ class IBFT:
             )
 
         # Batched path: snapshot, one host pass for the (cheap) hash
-        # equality, then ONE batch over the seals this engine has never
+        # equality, then verification of the seals this engine has never
         # verified before — repeat wakeups in the same phase re-verify
         # nothing (the verdict cache keys on the seal bytes themselves, so
         # a store-evicting rewrite from the same sender re-verifies).
+        # Fresh seals first consult the SPECULATION cache (verdicts the
+        # off-path worker produced while the phase was closed — ISSUE 9;
+        # the lookup binds height, round, carried hash, sender and
+        # signature, so a speculated verdict for proposal H can never
+        # certify H' at the same height/round), then drain with quorum
+        # EARLY-EXIT when the verifier supports it: verification stops at
+        # the exact voting-power quorum and the unverified remainder
+        # resolves lazily off-path.  Deferred lanes are neither valid nor
+        # invalid this wakeup — they stay in the store untouched.
         candidates, invalid = self._collect_commit_candidates(view, proposal)
         valid_messages: list[IbftMessage] = []
         if candidates:
@@ -902,25 +936,127 @@ class IBFT:
             ]
             verdicts = {k: round_cache[k] for k in keys if k in round_cache}
             fresh = [i for i, k in enumerate(keys) if k not in verdicts]
+            stored = 0
+
+            def note(i: int, ok: bool) -> None:
+                nonlocal stored
+                verdicts[keys[i]] = ok
+                round_cache[keys[i]] = ok
+                stored += 1
+
+            if fresh and self.speculator is not None:
+                missed = []
+                for i in fresh:
+                    hit = self.speculator.lookup_seal(
+                        view.height,
+                        view.round,
+                        keys[i][1],
+                        keys[i][0],
+                        keys[i][2],
+                    )
+                    if hit is None:
+                        missed.append(i)
+                    else:
+                        note(i, bool(hit))
+                fresh = missed
+            deferred: list[int] = []
             if fresh:
-                # All candidates share the proposal hash (hash check
-                # passed), so one batch per wakeup suffices.
+                deferred = self._verify_fresh_seals(
+                    view, candidates, keys, fresh, verdicts, note
+                )
+            mask = [verdicts.get(k) for k in keys]
+            for (message, _, _), ok in zip(candidates, mask):
+                if ok is None:
+                    continue  # deferred: not valid, not pruned
+                if ok:
+                    valid_messages.append(message)
+                else:
+                    invalid.append(message)
+            if deferred and not self._has_quorum_by_msg_type(
+                valid_messages, MessageType.COMMIT
+            ):
+                # Early-exit misprediction (the incremental tally and the
+                # exact quorum check disagreed): resolve the remainder NOW
+                # — liveness must never wait on an off-path worker, since
+                # no further wakeup is guaranteed.
                 fresh_mask = self.batch_verifier.verify_committed_seals(
                     candidates[0][1],
-                    [candidates[i][2] for i in fresh],
+                    [candidates[i][2] for i in deferred],
                     view.height,
                 )
-                for i, ok in zip(fresh, fresh_mask):
-                    verdicts[keys[i]] = bool(ok)
-                    round_cache[keys[i]] = bool(ok)
-                self._seal_verdict_count += len(fresh)
+                for i, ok in zip(deferred, fresh_mask):
+                    note(i, bool(ok))
+                    if bool(ok):
+                        valid_messages.append(candidates[i][0])
+                    else:
+                        invalid.append(candidates[i][0])
+                deferred = []
+            elif deferred and self.speculator is not None:
+                # Quorum certified without them: the remainder resolves
+                # lazily off-path and a later wakeup (or nothing at all)
+                # sees the verdicts as cache hits.
+                self.speculator.submit_seal_lanes(
+                    view.height,
+                    view.round,
+                    candidates[0][1],
+                    [
+                        (candidates[i][0].sender, candidates[i][2])
+                        for i in deferred
+                    ],
+                )
+            if stored:
+                self._seal_verdict_count += stored
                 self._evict_seal_verdicts(view.round)
-            mask = [verdicts[k] for k in keys]
-            valid_messages = self._partition_by_mask(candidates, mask, invalid)
 
         if invalid:
             self.messages.remove_messages(view, MessageType.COMMIT, invalid)
         return valid_messages
+
+    def _verify_fresh_seals(
+        self, view: View, candidates, keys, fresh, verdicts, note
+    ) -> list[int]:
+        """Verify the fresh commit-seal lanes, early-exiting at quorum.
+
+        Returns the lanes left unverified (deferred).  Without an
+        early-exit-capable verifier — or with ``commit_early_exit``
+        off — this is the original one-batch drain and nothing defers.
+        """
+        early = (
+            getattr(self.batch_verifier, "verify_seals_early_exit", None)
+            if self.commit_early_exit
+            else None
+        )
+        if early is None:
+            fresh_mask = self.batch_verifier.verify_committed_seals(
+                candidates[0][1],
+                [candidates[i][2] for i in fresh],
+                view.height,
+            )
+            for i, ok in zip(fresh, fresh_mask):
+                note(i, bool(ok))
+            return []
+        # Power already certified by cached/speculated verdicts shrinks
+        # the drain's stop threshold (distinct senders: the store holds
+        # one slot per sender, so candidate senders never repeat).
+        certified = sum(
+            self.validator_manager.power_of(candidates[i][0].sender)
+            for i, k in enumerate(keys)
+            if verdicts.get(k)
+        )
+        remaining = max(0, self.validator_manager.quorum_size - certified)
+        report = early(
+            candidates[0][1],
+            [candidates[i][2] for i in fresh],
+            view.height,
+            threshold=remaining,
+        )
+        deferred: list[int] = []
+        for j, i in enumerate(fresh):
+            if report.verified[j]:
+                note(i, bool(report.mask[j]))
+            else:
+                deferred.append(i)
+        return deferred
 
     def _evict_seal_verdicts(self, current_round: int) -> None:
         """Oldest-round-first seal-verdict eviction (ADVICE r5).
@@ -1067,16 +1203,6 @@ class IBFT:
                 continue
             candidates.append((message, proposal_hash or b"", committed_seal))
         return candidates, invalid
-
-    @staticmethod
-    def _partition_by_mask(candidates, mask, invalid) -> list[IbftMessage]:
-        valid_messages: list[IbftMessage] = []
-        for (message, _, _), ok in zip(candidates, mask):
-            if bool(ok):
-                valid_messages.append(message)
-            else:
-                invalid.append(message)
-        return valid_messages
 
     def _all_senders_valid(self, msgs: Sequence[IbftMessage]) -> bool:
         """IsValidValidator over a message set — batched when possible."""
@@ -1282,6 +1408,7 @@ class IBFT:
             self._buffer_future(message)
             return
         self.messages.add_message(message)
+        self._speculate([message])
         self._signal_if_quorum(message.view, message.type)
 
     def add_messages(self, batch: Sequence[IbftMessage]) -> None:
@@ -1319,6 +1446,7 @@ class IBFT:
             if message.view is not None:
                 key = (message.view.height, message.view.round, int(message.type))
                 to_signal.setdefault(key, (message.view, message.type))
+        self._speculate(accepted)
         for view, message_type in to_signal.values():
             self._signal_if_quorum(view, message_type)
 
@@ -1337,14 +1465,31 @@ class IBFT:
         sender evict a genuine message.
         """
         to_signal: dict[tuple[int, int, int], tuple[View, object]] = {}
+        stored: list[IbftMessage] = []
         for message in batch:
             if message.view is None or not isinstance(message.type, MessageType):
                 continue
             self.messages.add_message(message)
+            stored.append(message)
             key = (message.view.height, message.view.round, int(message.type))
             to_signal.setdefault(key, (message.view, message.type))
+        self._speculate(stored)
         for view, message_type in to_signal.values():
             self._signal_if_quorum(view, message_type)
+
+    def _speculate(self, msgs: Sequence[IbftMessage]) -> None:
+        """Queue stored COMMITs' seals for off-path speculative
+        verification (no-op without a speculator).  Runs AFTER the store
+        insert so a verdict can never exist for a message the store
+        rejected; the cache key binds the carried proposal hash, so the
+        verdict is only ever a hit when the drain's accepted proposal
+        matches."""
+        if self.speculator is None or not msgs:
+            return
+        try:
+            self.speculator.submit_commit_messages(msgs)
+        except Exception as err:  # noqa: BLE001 - speculation is advisory
+            self.log.debug("speculative submit failed", err)
 
     # -- future-height buffer (chain handoff support) -----------------------
 
@@ -1590,6 +1735,8 @@ class IBFT:
         # (entries packed for dead rounds yield before the live round's).
         if hasattr(self.batch_verifier, "note_round"):
             self.batch_verifier.note_round(round_)
+        if self.speculator is not None:
+            self.speculator.note_view(self.state.height, round_)
         self.state.set_view(View(height=self.state.height, round=round_))
         self.state.set_round_started(False)
         self.state.set_proposal_message(None)

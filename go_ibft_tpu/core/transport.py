@@ -18,6 +18,7 @@ from collections import deque
 from typing import Callable, Optional, Protocol, Sequence
 
 from ..messages.wire import IbftMessage
+from ..obs import trace
 
 
 class Transport(Protocol):
@@ -85,6 +86,16 @@ class BatchingIngress:
     sliding window: counts older than ``max_delay`` fall out, so a steady
     sub-cutover trickle never chains itself over the threshold, and any
     idle gap drops straight back to eager.
+
+    **Arrival calibration (ISSUE 9).**  When the timed window engages it
+    is no longer the fixed ``max_delay``: an
+    :class:`~go_ibft_tpu.utils.calibration.ArrivalCalibrator` tracks the
+    stream's EWMA inter-arrival gap and the wait becomes the PROJECTED
+    time for the remaining ``max_batch`` lanes to arrive — a flood pays
+    microseconds instead of the full 2 ms tail, and a stream measured too
+    slow to fill the batch inside the ceiling flushes eagerly instead of
+    idling.  ``max_delay`` stays the hard ceiling; pass
+    ``calibrate=False`` for the fixed legacy window.
     """
 
     def __init__(
@@ -94,10 +105,11 @@ class BatchingIngress:
         max_batch: int = 256,
         max_delay: float = 0.002,
         eager_cutover: Optional[int] = None,
+        calibrate: bool = True,
     ) -> None:
-        if eager_cutover is None:
-            from ..utils import calibration
+        from ..utils import calibration
 
+        if eager_cutover is None:
             eager_cutover = (
                 calibration.measured_cutover() or calibration.DEFAULT_CUTOVER_LANES
             )
@@ -107,6 +119,11 @@ class BatchingIngress:
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.eager_cutover = eager_cutover
+        self.calibrator = (
+            calibration.ArrivalCalibrator(max_window_s=max_delay)
+            if calibrate
+            else None
+        )
         # Sliding window of recent flushes [(monotonic t, n), ...] whose
         # total within the trailing ``max_delay`` is the device-sized-flow
         # detector.  A true window, not a chained sum: flushes spaced just
@@ -120,15 +137,33 @@ class BatchingIngress:
         while self._recent and now - self._recent[0][0] > self.max_delay:
             self._recent_n -= self._recent.popleft()[1]
 
+    def _window(self) -> float:
+        """The timed-window wait: calibrated projection, ceiling-clamped."""
+        if self.calibrator is None:
+            return self.max_delay
+        window = self.calibrator.window(len(self._buffer), self.max_batch)
+        trace.instant(
+            "ingress.calibrate",
+            window_us=round(window * 1e6, 1),
+            pending=len(self._buffer),
+        )
+        return window
+
     def submit(self, message: IbftMessage) -> None:
         self._buffer.append(message)
+        if self.calibrator is not None:
+            self.calibrator.observe()
         if len(self._buffer) >= self.max_batch:
             self.flush()
         elif self._handle is None:
             loop = asyncio.get_running_loop()
             self._trim_recent(time.monotonic())
             if self._recent_n + len(self._buffer) >= self.eager_cutover:
-                self._handle = loop.call_later(self.max_delay, self.flush)
+                window = self._window()
+                if window > 0:
+                    self._handle = loop.call_later(window, self.flush)
+                else:
+                    self._handle = loop.call_soon(self.flush)
             else:
                 self._handle = loop.call_soon(self.flush)
 

@@ -629,16 +629,27 @@ def final_exp3(f: F12) -> F12:
 
 
 def f12_eq_one(f: F12) -> jnp.ndarray:
-    """f == 1 exactly (canonical comparison at the edges)."""
-    ok = jnp.ones((), dtype=bool)
+    """f == 1 exactly (canonical comparison at the edges).
+
+    The 12 tower components canonicalize in ONE stacked
+    :func:`~go_ibft_tpu.ops.bls_fp.canon_mod_p` call (axis -2 is the
+    component) instead of 12 separate instantiations — the sequential
+    carry/peel chain inside canon is most of the finish stage's trace,
+    and stacking dedups it 12-to-1 (same scan/dedup discipline as the
+    hard-part stage; semantics unchanged)."""
+    comps = []
     for k in range(6):
         e = _ek(f, k)
-        want_one = k == 0
-        c0 = fp.canon_mod_p(fp.renorm(e.c0))
-        c1 = fp.canon_mod_p(fp.renorm(e.c1))
-        ref = jnp.asarray(fp.to_mont(1).arr) if want_one else jnp.zeros_like(c0)
-        ok = ok & jnp.all(c0 == ref, axis=-1) & jnp.all(c1 == 0, axis=-1)
-    return ok
+        comps.append(fp.renorm(e.c0))
+        comps.append(fp.renorm(e.c1))
+    stacked = fp.FV(
+        jnp.stack([c.arr for c in comps], axis=-2),
+        max(c.bound for c in comps),
+    )
+    canon = fp.canon_mod_p(stacked)  # (..., 12, L)
+    ref = jnp.zeros_like(canon)
+    ref = ref.at[..., 0, :].set(jnp.asarray(fp.to_mont(1).arr))
+    return jnp.all(canon == ref, axis=(-2, -1))
 
 
 # -- host packing + the aggregate kernel ------------------------------------
@@ -749,27 +760,72 @@ def _easy_part_stage(arrs):
     return _f12_arrs(_f12_renorm_to(g))
 
 
-@jax.jit
-def _exp_neg_x_stage(arrs):
-    """One compiled a^x kernel; the pipeline dispatches it five times."""
-    f = _f12_from_arrs(arrs, F12_ONE)
-    return _f12_arrs(_f12_renorm_to(exp_by_neg_x(f)))
+def _f12_select(cond, a: F12, b: F12) -> F12:
+    """Branchless tree select (cond -> a); bounds follow fp.select."""
+    return jax.tree_util.tree_map(
+        lambda x, y: fp.select(
+            jnp.broadcast_to(cond, x.arr.shape[:-1]), x, y
+        ),
+        a,
+        b,
+        is_leaf=lambda n: isinstance(n, FV),
+    )
+
+
+# Per-step combine mode for the hard-part chain's five exp-by-x steps:
+# 0 = multiply by conj(cur), 1 = multiply by frob(cur, 1), 2 = take the
+# exp output alone.  Step 2's result is the chain's t (saved for the
+# finish stage); the final carry is t2 = t^(x^2).
+_HARD_PART_MODE = (0, 0, 1, 2, 2)
+_HARD_PART_SAVE = (False, False, True, False, False)
 
 
 @jax.jit
-def _mul_conj_stage(e_arrs, g_arrs):
-    """e * conj(g): combines an exp output into g^(x-1)."""
-    e = _f12_from_arrs(e_arrs, F12_ONE)
-    g = _f12_from_arrs(g_arrs, F12_ONE)
-    return _f12_arrs(_f12_renorm_to(f12_mul(e, f12_conj(g))))
+def _hard_part_stage(f_arrs):
+    """The 2020/875 hard-part chain as ONE five-step scan.
 
+    Mathematically identical to the old five separate exp dispatches —
 
-@jax.jit
-def _mul_frob1_stage(e_arrs, g_arrs):
-    """e * frob(g, 1): combines an exp output into g^(x+p)."""
-    e = _f12_from_arrs(e_arrs, F12_ONE)
-    g = _f12_from_arrs(g_arrs, F12_ONE)
-    return _f12_arrs(_f12_renorm_to(f12_mul(e, f12_frob(g, 1))))
+        t  = exp(f) * conj(f)        # f^(x-1)
+        t  = exp(t) * conj(t)        # ^(x-1)
+        t  = exp(t) * frob(t, 1)     # ^(x+p)       (saved)
+        t2 = exp(exp(t))             # ^(x^2)
+
+    — but the exp-by-x body (the bulk of the final exponentiation's
+    trace) appears ONCE instead of five times when the whole pipeline is
+    lowered as a single program (scripts/compile_budget.py pins exactly
+    that form: five inlined exp scans were most of the 414k-line
+    ``bls_aggregate_verify_8v`` trace).  The inter-step combines run
+    branchlessly: every step computes ``exp(cur) * sel(conj(cur) |
+    frob(cur,1))`` and selects between the product and the bare exp
+    output by the step's mode — two F12 muls of slack per verification
+    against four fewer copies of the exp trace.  Returns
+    ``(t2_arrs, t_arrs)`` for :func:`_finish_stage`.
+    """
+    mode = jnp.asarray(_HARD_PART_MODE, dtype=jnp.int32)
+    save = jnp.asarray(_HARD_PART_SAVE)
+
+    def body(carry, xs):
+        cur_arrs, saved_arrs = carry
+        m, sv = xs
+        cur = _f12_from_arrs(cur_arrs, F12_ONE)
+        e = exp_by_neg_x(cur)
+        operand = _f12_select(
+            m == 0,
+            _f12_renorm_to(f12_conj(cur)),
+            _f12_renorm_to(f12_frob(cur, 1)),
+        )
+        prod = _f12_renorm_to(f12_mul(e, operand))
+        nxt = _f12_select(m == 2, _f12_renorm_to(e), prod)
+        saved = _f12_select(
+            sv, nxt, _f12_from_arrs(saved_arrs, F12_ONE)
+        )
+        return (_f12_arrs(nxt), _f12_arrs(saved)), None
+
+    (t2_arrs, t_arrs), _ = jax.lax.scan(
+        body, (list(f_arrs), list(f_arrs)), (mode, save)
+    )
+    return t2_arrs, t_arrs
 
 
 @jax.jit
@@ -805,10 +861,11 @@ def aggregate_verify_commit(
     live mask ``(V,)`` (V a power of two).  Returns a scalar bool array.
 
     Dispatches the staged pipeline above: aggregation, one batched Miller
-    scan, then the final exponentiation as easy-part + five reuses of the
-    single compiled exp-by-x kernel.  Semantics are identical to the fused
-    form (same tower, same hard-part chain — see :func:`final_exp3`);
-    only the dispatch granularity differs.
+    scan, then the final exponentiation as easy-part + the hard-part
+    chain scanned over its five exp-by-x steps (ONE compiled trace of the
+    exp body instead of five — see :func:`_hard_part_stage`).  Semantics
+    are identical to the fused form (same tower, same hard-part chain —
+    see :func:`final_exp3`); only the dispatch granularity differs.
     """
     (pk_ax, npk_ay, sx0, sx1, sy0, sy1, nonempty) = _aggregate_stage(
         pk_x, pk_y, sig_x0, sig_x1, sig_y0, sig_y1, live
@@ -824,8 +881,5 @@ def aggregate_verify_commit(
         jnp.stack([jnp.asarray(_G1_GEN_Y), npk_ay]),
     )
     f = _easy_part_stage(prod)
-    t = _mul_conj_stage(_exp_neg_x_stage(f), f)  # f^(x-1)
-    t = _mul_conj_stage(_exp_neg_x_stage(t), t)  # ^(x-1)
-    t = _mul_frob1_stage(_exp_neg_x_stage(t), t)  # ^(x+p)
-    t2 = _exp_neg_x_stage(_exp_neg_x_stage(t))  # ^(x^2)
+    t2, t = _hard_part_stage(f)
     return _finish_stage(t2, t, f, nonempty)

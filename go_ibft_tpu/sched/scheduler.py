@@ -177,10 +177,16 @@ class _Tenant:
         tid: str,
         chain_id: str,
         validators: Callable[[int], Mapping[bytes, int]],
+        calibrator=None,
     ):
         self.tid = tid
         self.chain_id = chain_id
         self.validators = validators
+        # Per-tenant arrival model (ISSUE 9): EWMA inter-arrival rate,
+        # summed across active tenants to project how fast the shared
+        # dispatch will fill — the calibrated replacement for the fixed
+        # coalescing window.
+        self.calibrator = calibrator
         self.queue: Deque[_Request] = deque()
         self.queued_lanes = 0
         self.deficit = 0
@@ -232,10 +238,19 @@ class TenantScheduler:
         route: str = "auto",
         dispatcher: Optional[CoalescedDispatcher] = None,
         request_timeout_s: float = 30.0,
+        calibrate: bool = True,
     ):
         if max_dispatch_lanes < 1 or max_queue_lanes < 1 or quantum_lanes < 1:
             raise ValueError("scheduler bounds must be >= 1")
         self.window_s = window_s
+        # Arrival-calibrated windows (ISSUE 9): ``window_s`` becomes the
+        # CEILING; the actual wait for the oldest queued request is the
+        # projected dispatch-fill time at the measured aggregate arrival
+        # rate (per-tenant EWMA models, summed over tenants with queued
+        # work).  A stream measured too slow to fill the dispatch inside
+        # the ceiling flushes immediately instead of idling out the
+        # window.  ``calibrate=False`` restores the fixed window.
+        self.calibrate = calibrate
         self.max_dispatch_lanes = min(max_dispatch_lanes, _BATCH_BUCKETS[-1])
         self.max_queue_lanes = max_queue_lanes
         self.quantum_lanes = quantum_lanes
@@ -310,8 +325,17 @@ class TenantScheduler:
         with self._cv:
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} already registered")
+            from ..utils.calibration import ArrivalCalibrator
+
             tenant = _Tenant(
-                tenant_id, chain_id or tenant_id, validators_for_height
+                tenant_id,
+                chain_id or tenant_id,
+                validators_for_height,
+                calibrator=(
+                    ArrivalCalibrator(max_window_s=self.window_s)
+                    if self.calibrate
+                    else None
+                ),
             )
             self._tenants[tenant_id] = tenant
             self._rr.append(tenant_id)
@@ -356,6 +380,8 @@ class TenantScheduler:
                     f"lanes (cap {self.max_queue_lanes})"
                 )
             req.submitted_at = time.monotonic()
+            if tenant.calibrator is not None:
+                tenant.calibrator.observe(req.lanes, now=req.submitted_at)
             tenant.queue.append(req)
             tenant.queued_lanes += req.lanes
             self._pending_reqs += 1
@@ -376,6 +402,41 @@ class TenantScheduler:
         ts = [t.queue[0].submitted_at for t in self._tenants.values() if t.queue]
         return min(ts) if ts else None
 
+    def _window_locked(self) -> float:
+        """The coalescing window for the current backlog, from the
+        measured AGGREGATE arrival rate (per-tenant EWMA models summed
+        over tenants with queued work) through the shared
+        :func:`~go_ibft_tpu.utils.calibration.calibrated_window` policy:
+        the fill projection when the dispatch will fill inside the
+        ``window_s`` ceiling, the ceiling when a sustained flood merely
+        cannot fill ALL of it, eager (0) only when the ceiling would
+        gain almost nothing.  Falls back to the fixed ``window_s`` when
+        no rate has been measured yet."""
+        if not self.calibrate:
+            return self.window_s
+        from ..utils.calibration import calibrated_window
+
+        rate = 0.0
+        for t in self._tenants.values():
+            if t.queue and t.calibrator is not None:
+                r = t.calibrator.rate_per_s()
+                if r:
+                    rate += r
+        window = calibrated_window(
+            rate if rate > 0 else None,
+            self._pending_lanes,
+            self.max_dispatch_lanes,
+            self.window_s,
+        )
+        trace.instant(
+            "ingress.calibrate",
+            scope="sched",
+            window_us=round(window * 1e6, 1),
+            rate_per_s=round(rate, 1),
+            pending=self._pending_lanes,
+        )
+        return window
+
     def _loop(self) -> None:
         while True:
             with self._cv:
@@ -384,15 +445,16 @@ class TenantScheduler:
                 if self._pending_reqs == 0 and not self._running:
                     return
                 # Demand-aware window: flush at bucket-full, or when the
-                # oldest queued request ages past the window.  Idle
-                # tenants contribute no requests and thus no delay.
+                # oldest queued request ages past the (arrival-calibrated)
+                # window.  Idle tenants contribute no requests and thus no
+                # delay.
                 while self._running:
                     if self._pending_lanes >= self.max_dispatch_lanes:
                         break
                     oldest = self._oldest_ts_locked()
                     if oldest is None:
                         break
-                    wait = oldest + self.window_s - time.monotonic()
+                    wait = oldest + self._window_locked() - time.monotonic()
                     if wait <= 0:
                         break
                     self._cv.wait(timeout=wait)
@@ -549,6 +611,9 @@ class TenantScheduler:
                 "shed_lanes": t.shed_lanes,
                 "drain_p50_ms": _percentile(samples, 0.50),
                 "drain_p99_ms": _percentile(samples, 0.99),
+                "arrival": (
+                    t.calibrator.stats() if t.calibrator is not None else None
+                ),
             }
 
         with self._cv:

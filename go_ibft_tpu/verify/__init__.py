@@ -23,11 +23,22 @@ stage 4):
 
 All return identical boolean masks for identical inputs — determinism
 across backends is part of the conformance suite.
+
+Two cross-cutting latency planes ride on top (ISSUE 9):
+
+* every rung serves ``verify_seals_early_exit`` — a seal drain that
+  stops at the exact voting-power quorum and reports the unverified
+  remainder (:class:`EarlyExitReport`) for lazy off-path resolution;
+* :class:`SpeculativeVerifier` + :class:`SpeculationCache`
+  (:mod:`go_ibft_tpu.verify.speculate`) verify cross-phase arrivals as
+  they land, hash-bound so a verdict can never leak across a different
+  (height, round, proposal hash, phase, sender, signature) binding.
 """
 
 from .batch import (
     AdaptiveBatchVerifier,
     DeviceBatchVerifier,
+    EarlyExitReport,
     EngineScope,
     HostBatchVerifier,
     MalformedLaneError,
@@ -36,17 +47,21 @@ from .batch import (
 )
 from .mesh_batch import MeshBatchVerifier
 from .pipeline import CircuitBreaker, PackCache, VerifyPipeline
+from .speculate import SpeculationCache, SpeculativeVerifier
 
 __all__ = [
     "AdaptiveBatchVerifier",
     "CircuitBreaker",
     "DeviceBatchVerifier",
+    "EarlyExitReport",
     "EngineScope",
     "HostBatchVerifier",
     "MalformedLaneError",
     "MeshBatchVerifier",
     "PackCache",
     "ResilientBatchVerifier",
+    "SpeculationCache",
+    "SpeculativeVerifier",
     "VerifyPipeline",
     "SIG_BYTES",
 ]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import time
 from contextlib import ExitStack
+from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import jax
@@ -127,6 +128,54 @@ def host_quorum_reached(
     if thr <= 0:
         return True
     return sum(powers.get(a, 0) for a in set(valid_addrs)) >= thr
+
+
+EARLY_EXIT_SKIPPED_KEY = ("go-ibft", "early_exit", "lanes_skipped")
+EARLY_EXIT_DRAINS_KEY = ("go-ibft", "early_exit", "drains")
+
+
+@dataclass
+class EarlyExitReport:
+    """One early-exit seal drain's outcome.
+
+    ``mask`` carries per-lane verdicts — ``False`` both for invalid lanes
+    and for lanes the drain never reached; ``verified`` distinguishes
+    them (True = the lane has a REAL verdict, bit-identical to the
+    sequential oracle's).  ``reached`` is the exact voting-power quorum
+    over the verified-valid distinct signers; ``skipped`` counts the
+    lanes left unverified (the caller resolves them lazily off-path —
+    typically via :class:`~go_ibft_tpu.verify.speculate.
+    SpeculativeVerifier` — or synchronously if the early exit
+    mispredicted).  Early-exit changes WHEN a lane verifies, never a
+    verdict.
+    """
+
+    mask: np.ndarray
+    verified: np.ndarray
+    reached: bool
+    skipped: int
+
+
+class _PowerTally:
+    """Exact incremental voting-power quorum (distinct signers counted
+    once — the ``has_quorum`` / :func:`host_quorum_reached` semantics,
+    fed one verdict at a time)."""
+
+    def __init__(self, powers: Mapping[bytes, int], threshold: int):
+        self.powers = powers
+        self.threshold = threshold
+        self.power = 0
+        self._counted: set = set()
+
+    @property
+    def reached(self) -> bool:
+        return self.power >= self.threshold
+
+    def add(self, signer: bytes) -> bool:
+        if signer not in self._counted:
+            self._counted.add(signer)
+            self.power += self.powers.get(signer, 0)
+        return self.reached
 
 
 def split_signature(sig: bytes) -> Tuple[int, int, int]:
@@ -284,6 +333,68 @@ class HostBatchVerifier:
                         and self._is_member(height, seal.signer)
                     )
         return out
+
+    def verify_seals_early_exit(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> EarlyExitReport:
+        """Arrival-order seal verification that RETURNS at quorum.
+
+        Lanes verify sequentially in the order they arrived; the exact
+        voting-power tally (distinct signers once) runs alongside, and
+        the loop stops the moment accumulated verified power reaches
+        ``threshold`` (the height's quorum when None).  Every verdict
+        produced is bit-identical to :meth:`verify_committed_seals`'s for
+        that lane; lanes past the cut are reported ``skipped`` for the
+        caller to resolve lazily.  Malformed lanes cost no crypto and
+        get their (False) verdict immediately, like the full drain.
+        """
+        n = len(seals)
+        mask = np.zeros(n, dtype=bool)
+        verified = np.zeros(n, dtype=bool)
+        powers = self._validators(height)
+        thr = (
+            calculate_quorum(sum(powers.values()))
+            if threshold is None
+            else threshold
+        )
+        if len(proposal_hash) != 32:
+            verified[:] = True  # batch-wide reject: every verdict is False
+            return EarlyExitReport(mask, verified, thr <= 0, 0)
+        tally = _PowerTally(powers, thr)
+        done = 0
+        with trace.span(
+            "verify.early_exit", route="host", kind="seals", lanes=n
+        ):
+            for i, seal in enumerate(seals):
+                if tally.reached:
+                    break
+                verified[i] = True
+                done = i + 1
+                if (
+                    len(seal.signer) != ADDRESS_BYTES
+                    or len(seal.signature) != SIG_BYTES
+                ):
+                    continue
+                r, s, v = split_signature(seal.signature)
+                pub = self._recover(proposal_hash, r, s, v)
+                if pub is None:
+                    continue
+                ok = (
+                    host_ecdsa.pubkey_to_address(*pub) == seal.signer
+                    and self._is_member(height, seal.signer)
+                )
+                mask[i] = ok
+                if ok:
+                    tally.add(seal.signer)
+        skipped = n - done
+        metrics.inc_counter(EARLY_EXIT_DRAINS_KEY)
+        if skipped:
+            metrics.inc_counter(EARLY_EXIT_SKIPPED_KEY, skipped)
+        return EarlyExitReport(mask, verified, tally.reached, skipped)
 
 
 # ---------------------------------------------------------------------------
@@ -1387,6 +1498,93 @@ class DeviceBatchVerifier:
                     out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
+    def verify_seals_early_exit(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> EarlyExitReport:
+        """Power-ordered chunked seal drain that STOPS DISPATCHING at
+        quorum.
+
+        Lanes are ordered by claimed signer power (descending, stable)
+        so the fewest chunks cover the threshold; the first chunk is the
+        smallest lane bucket covering the claimed-power quorum prefix
+        (optimistic: every lane valid), subsequent chunks double.  After
+        each readback the exact host-int tally updates and the loop
+        exits before the next dispatch once quorum is certain —
+        remaining lanes are reported ``skipped``.  Verdicts for
+        dispatched lanes are the kernel's usual mask, bit-identical to
+        the sequential oracle; the mesh subclass shards each chunk like
+        any other drain.
+        """
+        n = len(seals)
+        mask = np.zeros(n, dtype=bool)
+        verified = np.zeros(n, dtype=bool)
+        powers = self._validators(height)
+        thr = (
+            calculate_quorum(sum(powers.values()))
+            if threshold is None
+            else threshold
+        )
+        well_formed = [i for i, s in enumerate(seals) if self._well_formed_seal(s)]
+        if len(proposal_hash) != 32:
+            verified[:] = True
+            return EarlyExitReport(mask, verified, thr <= 0, 0)
+        # Malformed lanes have their (False) verdict without crypto.
+        malformed = set(range(n)) - set(well_formed)
+        if malformed:
+            verified[np.asarray(sorted(malformed))] = True
+        # Power-ordered, stable: arrival order breaks ties so equal-power
+        # sets (the common 1-power-each committee) drain in arrival order.
+        order = sorted(
+            well_formed, key=lambda i: -powers.get(seals[i].signer, 0)
+        )
+        # First chunk: the claimed-power quorum prefix, bucket-padded —
+        # the extra bucket lanes are verified for free (they pad anyway).
+        claimed = _PowerTally(powers, thr)
+        prefix = 0
+        for i in order:
+            prefix += 1
+            if claimed.add(seals[i].signer):
+                break
+        chunk = (
+            min(_bucket(max(prefix, 1), _BATCH_BUCKETS), self._dispatch_cap)
+            if order
+            else 0
+        )
+        tally = _PowerTally(powers, thr)
+        pos = 0
+        with trace.span(
+            "verify.early_exit",
+            route=self._route,
+            kind="seals",
+            lanes=n,
+        ):
+            while pos < len(order) and not tally.reached:
+                take = order[pos : pos + chunk]
+                cmask, _ = self._dispatch(
+                    self._seal_inputs(
+                        proposal_hash, [seals[i] for i in take]
+                    ),
+                    self._table_dev(height),
+                    None,
+                    "early_exit_ms",
+                )
+                for j, i in enumerate(take):
+                    verified[i] = True
+                    if cmask[j]:
+                        mask[i] = True
+                        tally.add(seals[i].signer)
+                pos += len(take)
+                chunk = min(chunk * 2, self._dispatch_cap)
+        skipped = len(order) - pos
+        metrics.inc_counter(EARLY_EXIT_DRAINS_KEY)
+        if skipped:
+            metrics.inc_counter(EARLY_EXIT_SKIPPED_KEY, skipped)
+        return EarlyExitReport(mask, verified, tally.reached, skipped)
+
     def verify_round_chunked(
         self,
         msgs: Sequence[IbftMessage],
@@ -1606,6 +1804,73 @@ class ResilientBatchVerifier:
             lambda rung, idxs: self._run_seal_lanes(
                 rung, [lanes[i] for i in idxs], height
             ),
+        )
+
+    def verify_seals_early_exit(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> EarlyExitReport:
+        """Early-exit drain through the degradation ladder.
+
+        The breaker's active rung serves the early-exit shape when it
+        has one (mesh/device/host all do); a rung fault — or a malformed
+        lane, which the early-exit packers cannot bisect around — falls
+        back to the FULL resilient drain (quarantine + breaker
+        accounting intact), reported with ``skipped=0``.  Early-exit
+        never weakens the ladder's liveness contract: a verdict per lane
+        is always available, it just may arrive via the full drain.
+        """
+        seals = list(seals)
+        level, probe = self.breaker.acquire()
+        if self.mesh is not None and level == 0 and len(seals) < self.mesh_cutover:
+            # Same lane-count cutover as _drain: small drains skip the
+            # padded multi-device launch; a pending mesh probe cannot be
+            # answered by a drain that will not run the mesh.
+            if probe:
+                self.breaker.abort_probe(level)
+                probe = False
+            level = 1
+        rung = self._rungs[level][1]
+        fn = getattr(rung, "verify_seals_early_exit", None)
+        if fn is not None:
+            try:
+                report = fn(
+                    proposal_hash, seals, height, threshold=threshold
+                )
+            except MalformedLaneError:
+                # Input poison, not a rung fault: release a pending
+                # probe; the full drain below quarantines the lane.
+                self.breaker.abort_probe(level)
+            except Exception:
+                # ONE breaker fault per underlying failure: the
+                # full-drain fallback below re-acquires this rung and
+                # its own accounting records the fault (and the
+                # DRAIN_FAULTS counter) exactly once.  A pending PROBE
+                # is the exception — the probed rung genuinely ran and
+                # failed, and leaving it unanswered would wedge the
+                # breaker's single-probe slot forever.
+                if probe:
+                    self.breaker.record_fault(level)
+            else:
+                self.breaker.record_success(level)
+                return report
+        elif probe:
+            self.breaker.abort_probe(level)
+        # Full-resilient fallback: bisection/quarantine semantics, every
+        # lane verified (no skip), exact host-int quorum over the valid
+        # signers.
+        mask = self.verify_committed_seals(proposal_hash, seals, height)
+        reached = host_quorum_reached(
+            self.host._validators,
+            [s.signer for s, ok in zip(seals, mask) if ok],
+            height,
+            threshold,
+        )
+        return EarlyExitReport(
+            mask, np.ones(len(seals), dtype=bool), reached, 0
         )
 
     @staticmethod
@@ -1853,6 +2118,25 @@ class AdaptiveBatchVerifier:
         if self._host_sized(len(lanes)):
             return self.host.verify_seal_lanes(lanes, height)
         return self._resilient.verify_seal_lanes(lanes, height)
+
+    def verify_seals_early_exit(
+        self,
+        proposal_hash: bytes,
+        seals: Sequence[CommittedSeal],
+        height: int,
+        threshold: Optional[int] = None,
+    ) -> EarlyExitReport:
+        """Early-exit seal drain, routed like every other seal drain:
+        tiny batches take the sequential host early-exit (arrival-order
+        stop-at-quorum), larger ones the ladder's power-ordered chunked
+        route (mesh/device with full breaker accounting)."""
+        if self._host_sized(len(seals)):
+            return self.host.verify_seals_early_exit(
+                proposal_hash, seals, height, threshold=threshold
+            )
+        return self._resilient.verify_seals_early_exit(
+            proposal_hash, seals, height, threshold=threshold
+        )
 
     # -- FusedBatchVerifier ---------------------------------------------
 

@@ -12,8 +12,16 @@ stablehlo lines, and fails when any grows >10% over the checked-in
 snapshot (docs/compile_budget.json).
 
 Usage:
-    python scripts/compile_budget.py            # compare vs snapshot
+    python scripts/compile_budget.py            # compare vs snapshot (10%)
+    python scripts/compile_budget.py --check    # CI ratchet: fail on >2%
     python scripts/compile_budget.py --write    # regenerate the snapshot
+
+``--check`` is the CI gate (ISSUE 9 satellite): the default 10% slack
+exists for local iteration, but a program that quietly grows 9% per PR
+compounds into minutes of cold compile within a quarter — the ratchet
+holds every pinned program within 2% of its snapshot, so growth must be
+CONSCIOUS (shrink the program or re-baseline with --write in the same
+PR, where review sees the new number).
 """
 
 import json
@@ -38,7 +46,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 SNAPSHOT = pathlib.Path(__file__).resolve().parent.parent / "docs" / "compile_budget.json"
+# Local-iteration slack vs the CI ratchet (--check): trace size is
+# cold-compile time, and sub-10% growths compound silently PR over PR.
 GROWTH_LIMIT = 0.10
+CHECK_GROWTH_LIMIT = 0.02
 
 
 def _programs() -> dict:
@@ -170,6 +181,9 @@ def _check_sched_reuses_pinned_programs() -> list:
 def main() -> int:
     import jax
 
+    growth_limit = (
+        CHECK_GROWTH_LIMIT if "--check" in sys.argv else GROWTH_LIMIT
+    )
     t0 = time.time()
     measured = _programs()
     measured["_trace_seconds"] = round(time.time() - t0, 1)
@@ -216,7 +230,7 @@ def main() -> int:
             failures.append(f"{name}: no snapshot entry (run --write)")
             continue
         growth = (lines - base) / base
-        status = "FAIL" if growth > GROWTH_LIMIT else "ok"
+        status = "FAIL" if growth > growth_limit else "ok"
         print(
             json.dumps(
                 {
@@ -224,14 +238,15 @@ def main() -> int:
                     "lines": lines,
                     "snapshot": base,
                     "growth": round(growth, 4),
+                    "limit": growth_limit,
                     "status": status,
                 }
             )
         )
-        if growth > GROWTH_LIMIT:
+        if growth > growth_limit:
             failures.append(
                 f"{name}: {lines} lines vs snapshot {base} (+{growth:.1%} > "
-                f"{GROWTH_LIMIT:.0%}) — trace size is cold-compile time; "
+                f"{growth_limit:.0%}) — trace size is cold-compile time; "
                 "shrink the program or consciously re-baseline with --write"
             )
     if failures:

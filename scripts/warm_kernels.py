@@ -99,6 +99,16 @@ def main() -> None:
     DeviceBatchVerifier(lambda h: {}).warmup()
     _stamp("DeviceBatchVerifier buckets", t0)
 
+    # Early-exit drain shapes (ISSUE 9): the power-ordered chunked seal
+    # drain dispatches the recover kernel at the quorum-prefix chunk
+    # bucket — (128 lanes, 128-row table) for a 100-validator committee,
+    # and the (8, 128) shape the weighted-committee suites hit.  Cold-
+    # compiling either inside a test timeout is the failure mode this
+    # script exists to prevent.
+    t0 = time.perf_counter()
+    DeviceBatchVerifier(lambda h: {}).warmup(lanes=(8, 128), table_rows=128)
+    _stamp("early-exit drain shapes (8/128 lanes x 128-row table)", t0)
+
     for n in _sizes():
         t0 = time.perf_counter()
         w = build_round_workload(n)

@@ -1,0 +1,134 @@
+"""ArrivalCalibrator + calibrated coalescing windows (ISSUE 9).
+
+The fixed 2 ms window becomes a ceiling: the actual wait is the
+projected time for the remaining batch to arrive at the measured EWMA
+rate — a flood waits microseconds, a trickle flushes eagerly, and a
+cold model (or a disabled calibrator) behaves exactly like yesterday's
+fixed window.
+"""
+
+import asyncio
+
+import numpy as np
+
+from go_ibft_tpu.core.transport import BatchingIngress
+from go_ibft_tpu.sched import TenantScheduler
+from go_ibft_tpu.utils.calibration import ArrivalCalibrator
+
+
+def test_cold_model_returns_ceiling():
+    cal = ArrivalCalibrator(max_window_s=0.002)
+    assert cal.window(pending=0, target=256) == 0.002
+    cal.observe(now=1.0)  # one observation: still no inter-arrival gap
+    assert cal.window(pending=0, target=256) == 0.002
+
+
+def test_flood_shrinks_window_to_projection():
+    cal = ArrivalCalibrator(max_window_s=0.002, alpha=1.0)
+    cal.observe(now=1.0)
+    cal.observe(now=1.000002)  # 2 us gaps: a flood
+    # 100 remaining lanes at 2 us each -> 200 us, far under the ceiling
+    w = cal.window(pending=156, target=256)
+    assert 0 < w <= 0.0003
+    assert abs(w - 100 * 2e-6) < 1e-9
+
+
+def test_trickle_flushes_eagerly_not_at_ceiling():
+    cal = ArrivalCalibrator(max_window_s=0.002, alpha=1.0)
+    cal.observe(now=1.0)
+    cal.observe(now=1.001)  # 1 ms gaps: the ceiling gains only 2 lanes
+    assert cal.window(pending=1, target=256) == 0.0  # flush now
+
+
+def test_fast_flood_that_cannot_fill_batch_keeps_the_ceiling():
+    """Review regression: a sustained device-sized flood whose projected
+    fill time exceeds the ceiling must NOT collapse to eager flushing —
+    the ceiling still gains ~100 lanes, so it coalesces at the ceiling
+    (no discontinuous cliff at projected == max_window_s)."""
+    cal = ArrivalCalibrator(max_window_s=0.002, alpha=1.0)
+    cal.observe(now=1.0)
+    cal.observe(now=1.00002)  # 20 us gaps: 50k lanes/s
+    # 255 remaining lanes -> 5.1 ms projected > 2 ms ceiling, but the
+    # ceiling gains 100 lanes >> the 8-lane floor: wait the ceiling.
+    assert cal.window(pending=1, target=256) == 0.002
+
+
+def test_idle_gap_resets_model():
+    cal = ArrivalCalibrator(max_window_s=0.002, alpha=1.0, idle_reset_s=0.25)
+    cal.observe(now=1.0)
+    cal.observe(now=1.000002)
+    assert cal.rate_per_s() is not None
+    cal.observe(now=2.0)  # 1 s idle: flood-era rate is history
+    assert cal.rate_per_s() is None
+    assert cal.window(pending=0, target=256) == 0.002
+
+
+def test_burst_observation_divides_gap():
+    cal = ArrivalCalibrator(max_window_s=1.0, alpha=1.0)
+    cal.observe(n=1, now=1.0)
+    cal.observe(n=100, now=1.001)  # 100 lanes in 1 ms -> 10 us/lane
+    assert abs(cal.rate_per_s() - 100_000) < 1.0
+
+
+def test_stats_shape():
+    cal = ArrivalCalibrator()
+    s = cal.stats()
+    assert s["observed"] == 0 and s["rate_per_s"] is None
+    cal.observe(now=1.0)
+    cal.observe(now=1.01)
+    assert cal.stats()["rate_per_s"] is not None
+
+
+def test_batching_ingress_calibrated_window_engages():
+    """A device-sized flow's timed window is the calibrated projection,
+    never more than max_delay; the calibrator observes every submit."""
+    flushed = []
+
+    async def main():
+        ingress = BatchingIngress(
+            flushed.append, max_batch=64, max_delay=0.002, eager_cutover=4
+        )
+        for i in range(8):
+            ingress.submit(object())
+        ingress.flush()
+        assert ingress.calibrator is not None
+        assert ingress.calibrator.observed == 8
+        # loopback-tick floods arrive with ~0 gaps: the projected window
+        # for the next burst is (far) below the 2 ms ceiling
+        w = ingress._window()
+        assert 0 <= w <= 0.002
+        ingress.close()
+
+    asyncio.run(main())
+
+
+def test_batching_ingress_calibrate_off_is_fixed_window():
+    async def main():
+        ingress = BatchingIngress(
+            lambda batch: None, max_delay=0.002, calibrate=False
+        )
+        assert ingress.calibrator is None
+        assert ingress._window() == 0.002
+        ingress.close()
+
+    asyncio.run(main())
+
+
+def test_scheduler_calibrated_window_ceiling_and_projection():
+    sched = TenantScheduler(window_s=0.002, route="host", calibrate=True)
+    src = lambda h: {}  # noqa: E731 - membership unused here
+    sched.register("t1", src)
+    # No queued work, no measured rate: ceiling.
+    with sched._cv:
+        assert sched._window_locked() == 0.002
+    sched.calibrate = False
+    with sched._cv:
+        assert sched._window_locked() == 0.002
+
+
+def test_scheduler_stats_carry_arrival_model():
+    sched = TenantScheduler(window_s=0.002, route="host")
+    sched.register("t1", lambda h: {})
+    row = sched.stats()["tenants"]["t1"]
+    assert row["arrival"] is not None
+    assert row["arrival"]["observed"] == 0

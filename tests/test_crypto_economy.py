@@ -30,6 +30,16 @@ class CountingVerifier(HostBatchVerifier):
         self.seal_lanes += len(seals)
         return super().verify_committed_seals(proposal_hash, seals, height)
 
+    def verify_seals_early_exit(self, proposal_hash, seals, height, threshold=None):
+        # The early-exit drain (ISSUE 9) counts only the lanes it
+        # actually VERIFIED — deferred lanes cost no crypto until they
+        # resolve, which is exactly the economy this suite pins.
+        report = super().verify_seals_early_exit(
+            proposal_hash, seals, height, threshold=threshold
+        )
+        self.seal_lanes += int(report.verified.sum())
+        return report
+
 
 def _engine(n=4):
     keys = [PrivateKey.from_seed(b"econ-%d" % i) for i in range(n)]

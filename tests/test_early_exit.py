@@ -1,0 +1,266 @@
+"""Quorum early-exit drains: verdict parity with the sequential oracle.
+
+ISSUE 9 coverage: every route's ``verify_seals_early_exit`` must
+
+* produce, for every lane it VERIFIES, a verdict bit-identical to the
+  sequential host oracle's for that lane (early exit changes WHEN a lane
+  verifies, never a verdict);
+* stop at the exact voting-power quorum (distinct signers counted once)
+  and report the untouched remainder as ``skipped``;
+* resolve the remainder to the oracle's verdicts when the caller drains
+  it — under chaos too (malformed lanes past the quorum cut, a breaker
+  demotion mid-drain);
+
+on the host, device, mesh, and Resilient rungs.
+"""
+
+import jax
+import numpy as np
+
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+from go_ibft_tpu.messages.helpers import CommittedSeal, extract_committed_seal
+from go_ibft_tpu.messages.wire import Proposal, View
+from go_ibft_tpu.parallel import mesh_context
+from go_ibft_tpu.verify import (
+    AdaptiveBatchVerifier,
+    CircuitBreaker,
+    DeviceBatchVerifier,
+    HostBatchVerifier,
+    MeshBatchVerifier,
+    ResilientBatchVerifier,
+)
+from go_ibft_tpu.verify.batch import EarlyExitReport
+
+
+def _signed_seals(n, seed=0, powers=None, corrupt=()):
+    keys = [PrivateKey.from_seed(b"ee-%d-%d" % (seed, i)) for i in range(n)]
+    power_map = {
+        k.address: (powers[i] if powers is not None else 1)
+        for i, k in enumerate(keys)
+    }
+    src = ECDSABackend.static_validators(power_map)
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=1, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"ee block", round=0))
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    rng = np.random.default_rng(seed)
+    for i in corrupt:
+        sig = bytearray(seals[i].signature)
+        sig[int(rng.integers(0, 64))] ^= 0xFF
+        seals[i] = CommittedSeal(signer=seals[i].signer, signature=bytes(sig))
+    return phash, seals, src
+
+
+def _oracle_mask(phash, seals, src, height=1):
+    return HostBatchVerifier(src).verify_committed_seals(phash, seals, height)
+
+
+def _assert_verified_parity(report: EarlyExitReport, oracle: np.ndarray):
+    """Every verified lane's verdict equals the oracle's; unverified
+    lanes carry no verdict (mask False by construction)."""
+    assert (report.mask[report.verified] == oracle[report.verified]).all()
+    assert not report.mask[~report.verified].any()
+
+
+def test_host_early_exit_stops_at_quorum_arrival_order():
+    phash, seals, src = _signed_seals(8, seed=1)
+    oracle = _oracle_mask(phash, seals, src)
+    report = HostBatchVerifier(src).verify_seals_early_exit(phash, seals, 1)
+    # 8 equal-power validators, quorum 6: arrival order verifies exactly
+    # the first 6 (all valid) and skips the tail.
+    assert report.reached is True
+    assert report.skipped == 2
+    assert report.verified[:6].all() and not report.verified[6:].any()
+    _assert_verified_parity(report, oracle)
+
+
+def test_host_early_exit_remainder_resolves_to_oracle():
+    phash, seals, src = _signed_seals(8, seed=2, corrupt=(1, 6))
+    oracle = _oracle_mask(phash, seals, src)
+    host = HostBatchVerifier(src)
+    report = host.verify_seals_early_exit(phash, seals, 1)
+    _assert_verified_parity(report, oracle)
+    # Lazily resolve the remainder: combined verdicts == the full drain.
+    rest = [i for i in range(len(seals)) if not report.verified[i]]
+    combined = report.mask.copy()
+    if rest:
+        rest_mask = host.verify_committed_seals(
+            phash, [seals[i] for i in rest], 1
+        )
+        combined[np.asarray(rest)] = rest_mask
+    assert (combined == oracle).all()
+
+
+def test_host_early_exit_corrupt_lanes_keep_verifying_past_them():
+    # Corrupt lanes contribute no power, so the cut moves past them; the
+    # verified set is a strict superset of the valid-quorum prefix.
+    phash, seals, src = _signed_seals(8, seed=3, corrupt=(0, 1, 2))
+    oracle = _oracle_mask(phash, seals, src)
+    report = HostBatchVerifier(src).verify_seals_early_exit(phash, seals, 1)
+    # 5 valid lanes of 8, quorum 6 (power includes corrupt validators'
+    # weight): cannot be reached — every lane verifies, nothing skipped.
+    assert report.reached is False
+    assert report.skipped == 0
+    assert report.verified.all()
+    assert (report.mask == oracle).all()
+
+
+def test_host_early_exit_threshold_and_malformed_hash():
+    phash, seals, src = _signed_seals(6, seed=4)
+    report = HostBatchVerifier(src).verify_seals_early_exit(
+        phash, seals, 1, threshold=2
+    )
+    assert report.reached is True and report.skipped == 4
+    bad = HostBatchVerifier(src).verify_seals_early_exit(b"short", seals, 1)
+    assert not bad.mask.any() and bad.verified.all() and bad.skipped == 0
+
+
+def test_host_early_exit_malformed_lane_past_cut_never_touched():
+    phash, seals, src = _signed_seals(8, seed=5)
+    seals[7] = CommittedSeal(signer=seals[7].signer, signature=b"\x01" * 3)
+    report = HostBatchVerifier(src).verify_seals_early_exit(phash, seals, 1)
+    assert report.reached and report.skipped == 2
+    assert not report.verified[7]  # past the cut: no crypto, no verdict
+
+
+def test_device_early_exit_power_ordered_chunks_skip_tail():
+    # One heavy validator (power 10) + nine 1s: total 19, quorum 13 —
+    # the power-ordered prefix is 4 lanes, bucket-padded to an 8-lane
+    # chunk, so the drain verifies 8 and skips 2 without a second
+    # dispatch (the chunk shape every suite already compiles).
+    phash, seals, src = _signed_seals(10, seed=6, powers=[10] + [1] * 9)
+    oracle = _oracle_mask(phash, seals, src)
+    device = DeviceBatchVerifier(src)
+    report = device.verify_seals_early_exit(phash, seals, 1)
+    assert report.reached is True
+    assert report.skipped == 2
+    assert int(report.verified.sum()) == 8
+    _assert_verified_parity(report, oracle)
+
+
+def test_device_early_exit_corrupt_heavy_lane_forces_second_chunk():
+    # The heavy lane is corrupt: the optimistic first chunk cannot reach
+    # quorum, the drain continues into the tail, verdicts stay
+    # oracle-exact throughout.
+    phash, seals, src = _signed_seals(
+        10, seed=7, powers=[10] + [1] * 9, corrupt=(0,)
+    )
+    oracle = _oracle_mask(phash, seals, src)
+    device = DeviceBatchVerifier(src)
+    report = device.verify_seals_early_exit(phash, seals, 1)
+    # quorum 13 needs 9 of the 1-power lanes: unreachable (only 9 valid
+    # = power 9 < 13) -> every lane verified.
+    assert report.reached is False and report.skipped == 0
+    assert (report.mask == oracle).all()
+
+
+def test_device_early_exit_malformed_lane_verdict_without_crypto():
+    phash, seals, src = _signed_seals(10, seed=8, powers=[10] + [1] * 9)
+    seals[9] = CommittedSeal(signer=b"\x02" * 3, signature=b"\x01" * 65)
+    oracle = _oracle_mask(phash, seals, src)
+    report = DeviceBatchVerifier(src).verify_seals_early_exit(phash, seals, 1)
+    assert report.verified[9] and not report.mask[9]
+    _assert_verified_parity(report, oracle)
+
+
+def test_mesh_early_exit_sharded_chunks_oracle_exact():
+    phash, seals, src = _signed_seals(10, seed=9, powers=[10] + [1] * 9)
+    oracle = _oracle_mask(phash, seals, src)
+    mesh = MeshBatchVerifier(
+        src, mesh=mesh_context(2, devices=jax.devices()[:2])
+    )
+    report = mesh.verify_seals_early_exit(phash, seals, 1)
+    assert report.reached is True
+    _assert_verified_parity(report, oracle)
+    assert report.skipped == 2
+
+
+class _FaultingDevice(DeviceBatchVerifier):
+    """Device rung that raises on every early-exit dispatch."""
+
+    def __init__(self, src):
+        super().__init__(src)
+        self.early_calls = 0
+
+    def verify_seals_early_exit(self, *a, **kw):
+        self.early_calls += 1
+        raise RuntimeError("simulated XLA fault")
+
+    def verify_committed_seals(self, *a, **kw):
+        raise RuntimeError("simulated XLA fault")
+
+
+def test_resilient_early_exit_falls_back_to_full_drain_on_fault():
+    phash, seals, src = _signed_seals(8, seed=10, corrupt=(3,))
+    oracle = _oracle_mask(phash, seals, src)
+    device = _FaultingDevice(src)
+    ladder = ResilientBatchVerifier(device, validators_for_height=src)
+    report = ladder.verify_seals_early_exit(phash, seals, 1)
+    # The fault dropped to the full resilient drain: every lane verified
+    # (host rung), verdicts oracle-exact, nothing skipped.
+    assert device.early_calls == 1
+    assert report.skipped == 0 and report.verified.all()
+    assert (report.mask == oracle).all()
+    assert report.reached is True  # 7 valid of 8 >= quorum 6
+
+
+def test_resilient_early_exit_breaker_demotion_mid_drain():
+    phash, seals, src = _signed_seals(8, seed=11)
+    oracle = _oracle_mask(phash, seals, src)
+    device = _FaultingDevice(src)
+    breaker = CircuitBreaker(("device", "host", "python"), k=1, cooldown_s=1e9)
+    ladder = ResilientBatchVerifier(
+        device, validators_for_height=src, breaker=breaker
+    )
+    first = ladder.verify_seals_early_exit(phash, seals, 1)
+    assert (first.mask == oracle).all()
+    assert breaker.level == 1  # k=1: one fault demotes device -> host
+    # Demoted drains serve the early-exit shape from the HOST rung —
+    # arrival-order stop-at-quorum, no device call.
+    second = ladder.verify_seals_early_exit(phash, seals, 1)
+    assert device.early_calls == 1  # the device never ran again
+    assert second.reached is True and second.skipped == 2
+    _assert_verified_parity(second, oracle)
+
+
+def test_adaptive_routes_early_exit_by_size():
+    phash, seals, src = _signed_seals(8, seed=12)
+    oracle = _oracle_mask(phash, seals, src)
+    adaptive = AdaptiveBatchVerifier(src, cutover_lanes=64)
+    report = adaptive.verify_seals_early_exit(phash, seals, 1)
+    # below cutover: the sequential host early-exit served it
+    assert report.reached is True and report.skipped == 2
+    _assert_verified_parity(report, oracle)
+
+
+def test_seeded_chaos_parity_on_all_routes():
+    """Seeded malformed + corrupt lanes across every route: verified
+    verdicts are bit-identical to the oracle on each, including lanes
+    past the quorum cut resolved afterwards."""
+    phash, seals, src = _signed_seals(
+        10, seed=1337, powers=[10] + [1] * 9, corrupt=(2, 5)
+    )
+    seals[8] = CommittedSeal(signer=seals[8].signer, signature=b"")
+    oracle = _oracle_mask(phash, seals, src)
+    routes = {
+        "host": HostBatchVerifier(src),
+        "device": DeviceBatchVerifier(src),
+        "resilient": ResilientBatchVerifier(
+            DeviceBatchVerifier(src), validators_for_height=src
+        ),
+        "adaptive": AdaptiveBatchVerifier(src, cutover_lanes=4),
+    }
+    for name, route in routes.items():
+        report = route.verify_seals_early_exit(phash, seals, 1)
+        _assert_verified_parity(report, oracle)
+        rest = [i for i in range(len(seals)) if not report.verified[i]]
+        combined = report.mask.copy()
+        if rest:
+            combined[np.asarray(rest)] = HostBatchVerifier(
+                src
+            ).verify_committed_seals(phash, [seals[i] for i in rest], 1)
+        assert (combined == oracle).all(), name

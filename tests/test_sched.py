@@ -451,3 +451,74 @@ def test_engine_scope_shared_ladder_isolates_lifecycle():
     # The facade delegates the rest of the surface (warmup, quarantine).
     scope_b.quarantine(packed_b[:1])
     assert cache.lookup(packed_b[0]) is None
+
+
+def test_speculation_cache_owner_scoped_per_tenant():
+    """ISSUE 9 satellite: a SpeculationCache shared across tenants keys
+    every verdict by owner — two tenants speculating the SAME bytes (one
+    signed round, identical (height, round, hash, sender, signature))
+    get their OWN verdicts (membership differs per tenant), one tenant's
+    lifecycle hooks never touch the other's entries, and a lookup can
+    never cross owners."""
+    from go_ibft_tpu.verify import SpeculationCache, SpeculativeVerifier
+
+    r = build_signed_round(4, seed=11)
+    src_full = _src(11, 4)
+    # Tenant B recognizes only the first two validators: byte-identical
+    # seals, different membership -> different verdicts.
+    keys = [PrivateKey.from_seed(b"bench-%d-%d" % (11, i)) for i in range(4)]
+    src_partial = ECDSABackend.static_validators(
+        {k.address: 1 for k in keys[:2]}
+    )
+    sched = TenantScheduler(window_s=0.001, route="host")
+    ha = sched.register("a", src_full)
+    hb = sched.register("b", src_partial)
+    shared_cache = SpeculationCache()
+    spec_a = SpeculativeVerifier(ha, cache=shared_cache, owner="a")
+    spec_b = SpeculativeVerifier(hb, cache=shared_cache, owner="b")
+    from go_ibft_tpu.crypto.backend import ECDSABackend as _EB
+    from go_ibft_tpu.messages.wire import View
+
+    backends = [_EB(k, src_full) for k in keys]
+    commits = [
+        b.build_commit_message(r.proposal_hash, View(height=1, round=0))
+        for b in backends
+    ]
+    with sched:
+        assert spec_a.submit_commit_messages(commits) == 4
+        assert spec_b.submit_commit_messages(commits) == 4
+        assert spec_a.drain(10.0) and spec_b.drain(10.0)
+    from go_ibft_tpu.messages.helpers import extract_committed_seal
+
+    for i, commit in enumerate(commits):
+        seal = extract_committed_seal(commit)
+        assert (
+            spec_a.lookup_seal(
+                1, 0, r.proposal_hash, commit.sender, seal.signature
+            )
+            is True
+        )
+        expected_b = i < 2  # only the first two are B's members
+        assert (
+            spec_b.lookup_seal(
+                1, 0, r.proposal_hash, commit.sender, seal.signature
+            )
+            is expected_b
+        ), i
+    # A's lifecycle reset drops ONLY A's entries.
+    spec_a.reset()
+    seal0 = extract_committed_seal(commits[0])
+    assert (
+        spec_a.lookup_seal(
+            1, 0, r.proposal_hash, commits[0].sender, seal0.signature
+        )
+        is None
+    )
+    assert (
+        spec_b.lookup_seal(
+            1, 0, r.proposal_hash, commits[0].sender, seal0.signature
+        )
+        is True
+    )
+    spec_a.stop()
+    spec_b.stop()

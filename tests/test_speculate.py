@@ -1,0 +1,378 @@
+"""Speculative cross-phase verification: binding, Byzantine, lifecycle.
+
+ISSUE 9 coverage for the speculation plane:
+
+* cache verdicts are hash-bound to the FULL (owner, height, round,
+  proposal hash, phase, sender, signature) key — no partial match
+  exists, so a speculated verdict can never certify a different
+  proposal, round, sender, or tenant;
+* engine integration: COMMIT seals arriving while the phase is closed
+  verify off the event loop, and the drain is a cache hit;
+* early-exit remainders resolve lazily through the same worker;
+* quarantine eviction, round/height-scoped eviction, bounded queue,
+  worker faults are best-effort (never a wrong verdict, never a crash).
+"""
+
+import threading
+
+import numpy as np
+
+from go_ibft_tpu.core import IBFT
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend
+from go_ibft_tpu.messages import View
+from go_ibft_tpu.messages.helpers import extract_committed_seal
+from go_ibft_tpu.verify import HostBatchVerifier, SpeculationCache, SpeculativeVerifier
+from go_ibft_tpu.verify.speculate import PHASE_COMMIT_SEAL
+
+from harness import NullLogger
+
+
+class CountingVerifier(HostBatchVerifier):
+    def __init__(self, src):
+        super().__init__(src)
+        self.seal_lanes = 0
+        self.calls = 0
+
+    def verify_committed_seals(self, proposal_hash, seals, height):
+        self.seal_lanes += len(seals)
+        self.calls += 1
+        return super().verify_committed_seals(proposal_hash, seals, height)
+
+    def verify_seals_early_exit(self, proposal_hash, seals, height, threshold=None):
+        report = super().verify_seals_early_exit(
+            proposal_hash, seals, height, threshold=threshold
+        )
+        self.seal_lanes += int(report.verified.sum())
+        return report
+
+
+def _engine(n=4, speculator_from=None):
+    keys = [PrivateKey.from_seed(b"spec-%d" % i) for i in range(n)]
+    powers = {k.address: 1 for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    backends = [ECDSABackend(k, src) for k in keys]
+
+    class _T:
+        def multicast(self, message):
+            pass
+
+    verifier = CountingVerifier(src)
+    speculator = (
+        SpeculativeVerifier(verifier) if speculator_from is None else None
+    )
+    engine = IBFT(
+        NullLogger(),
+        backends[1],
+        _T(),
+        batch_verifier=verifier,
+        speculator=speculator,
+    )
+    engine.state.reset(1)
+    engine.validator_manager.init(1)
+    return engine, verifier, backends
+
+
+def _accept(engine, backends, height=1, round_=0, block=b"block 1"):
+    view = View(height=height, round=round_)
+    proposer = next(
+        b for b in backends if b.is_proposer(b.address, height, round_)
+    )
+    pmsg = proposer.build_preprepare_message(block, None, view)
+    engine._accept_proposal(pmsg)
+    return view, proposer, pmsg.preprepare_data.proposal_hash
+
+
+# -- cache binding ------------------------------------------------------
+
+
+def test_cache_binding_no_partial_match():
+    cache = SpeculationCache()
+    args = (1, 0, b"\xaa" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65)
+    cache.store(*args, True)
+    assert cache.lookup(*args) is True
+    # every single field perturbed -> miss
+    misses = [
+        (2, 0, b"\xaa" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65),
+        (1, 1, b"\xaa" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65),
+        (1, 0, b"\xbb" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65),
+        (1, 0, b"\xaa" * 32, "envelope", b"s" * 20, b"g" * 65),
+        (1, 0, b"\xaa" * 32, PHASE_COMMIT_SEAL, b"t" * 20, b"g" * 65),
+        (1, 0, b"\xaa" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"h" * 65),
+    ]
+    for key in misses:
+        assert cache.lookup(*key) is None, key
+    assert cache.lookup(*args, owner="tenant-b") is None
+
+
+def test_cache_owner_scoping_and_clear():
+    cache = SpeculationCache()
+    args = (5, 0, b"\xcc" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65)
+    cache.store(*args, True, owner="a")
+    cache.store(*args, False, owner="b")
+    assert cache.lookup(*args, owner="a") is True
+    assert cache.lookup(*args, owner="b") is False
+    cache.clear(owner="a")
+    assert cache.lookup(*args, owner="a") is None
+    assert cache.lookup(*args, owner="b") is False
+
+
+def test_note_view_drops_stale_heights_keeps_future():
+    cache = SpeculationCache()
+    for h in (1, 2, 3):
+        cache.store(
+            h, 0, b"\xdd" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65, True
+        )
+    cache.note_view(2, 0)
+    assert (
+        cache.lookup(1, 0, b"\xdd" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65)
+        is None
+    )
+    for h in (2, 3):  # live + future survive
+        assert (
+            cache.lookup(
+                h, 0, b"\xdd" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65
+            )
+            is True
+        )
+
+
+def test_cap_evicts_dead_views_before_live():
+    cache = SpeculationCache(cap=4)
+    cache.note_view(9, 3)
+    # live-view entries
+    for i in range(3):
+        cache.store(
+            9, 3, b"%02d" % i * 16, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65, True
+        )
+    # dead-round entries push past the cap: they evict first
+    for i in range(4):
+        cache.store(
+            9, 1, b"%02d" % i * 16, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65, True
+        )
+    assert len(cache) <= 4
+    for i in range(3):
+        assert (
+            cache.lookup(
+                9, 3, b"%02d" % i * 16, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65
+            )
+            is True
+        )
+
+
+# -- engine integration -------------------------------------------------
+
+
+def test_ingress_speculation_makes_drain_crypto_free():
+    engine, verifier, backends = _engine()
+    view, proposer, phash = _accept(engine, backends)
+    others = [b for b in backends if b is not proposer]
+    engine.add_messages([b.build_commit_message(phash, view) for b in others])
+    assert engine.speculator.drain(10.0)
+    # the worker verified every seal exactly once, off-path
+    assert verifier.seal_lanes == len(others)
+    lanes_before = verifier.seal_lanes
+    assert engine._handle_commit(view)  # quorum: 3 of 4
+    # the drain was pure cache hits — zero additional crypto lanes
+    assert verifier.seal_lanes == lanes_before
+    assert len(engine.state.committed_seals) == len(others)
+    engine.speculator.stop()
+
+
+def test_speculated_verdict_for_H_cannot_certify_Hprime():
+    """Byzantine regression (ISSUE 9 satellite): commits speculated for
+    proposal hash H must not certify a DIFFERENT accepted proposal H' at
+    the same height/round — neither via the hash filter (carried hash
+    mismatches) nor via the cache (the key binds the hash)."""
+    engine, verifier, backends = _engine()
+    view = View(height=1, round=0)
+    proposer = next(b for b in backends if b.is_proposer(b.address, 1, 0))
+    others = [b for b in backends if b is not proposer]
+    # Commits for block H arrive and speculate BEFORE any proposal lands.
+    pmsg_h = proposer.build_preprepare_message(b"block H", None, view)
+    phash_h = pmsg_h.preprepare_data.proposal_hash
+    engine.add_messages(
+        [b.build_commit_message(phash_h, view) for b in others]
+    )
+    assert engine.speculator.drain(10.0)
+    assert engine.speculator.cache.hits == 0
+    # The engine then accepts H' (equivocating proposer).
+    pmsg_hp = proposer.build_preprepare_message(b"block H'", None, view)
+    engine._accept_proposal(pmsg_hp)
+    assert not engine._handle_commit(view)
+    assert engine.state.committed_seals == []
+    # The speculated verdicts were never consulted for H' (hash filter
+    # rejects the carried hash first; the binding would miss anyway).
+    assert (
+        engine.speculator.lookup_seal(
+            1, 0, pmsg_hp.preprepare_data.proposal_hash,
+            others[0].address,
+            extract_committed_seal(
+                others[0].build_commit_message(phash_h, view)
+            ).signature,
+        )
+        is None
+    )
+    # Accepting H afterwards DOES finalize from the same speculated
+    # verdicts.  The H' drain pruned the mismatching commits from the
+    # store (the engine's standing posture for hash-invalid lanes), so
+    # the network redelivers them — ingress dedups against the cache
+    # (nothing re-queues) and the drain is pure cache hits.
+    engine._accept_proposal(pmsg_h)
+    engine.add_messages(
+        [b.build_commit_message(phash_h, view) for b in others]
+    )
+    assert engine.speculator.drain(10.0)
+    lanes_before = verifier.seal_lanes
+    assert engine._handle_commit(view)
+    assert verifier.seal_lanes == lanes_before  # pure cache hits
+    assert len(engine.state.committed_seals) == len(others)
+    engine.speculator.stop()
+
+
+def test_early_exit_remainder_resolves_offpath():
+    engine, verifier, backends = _engine()
+    # Detach the speculator during ingress so the seals arrive unverified
+    # (forcing a real early-exit, not a cache-warm drain).
+    speculator = engine.speculator
+    engine.speculator = None
+    view, proposer, phash = _accept(engine, backends)
+    commits = [b.build_commit_message(phash, view) for b in backends]
+    engine.add_messages(commits)
+    engine.speculator = speculator
+    assert engine._handle_commit(view)
+    # quorum is 3 of 4: the drain verified exactly 3 ON-PATH and
+    # deferred the 4th (which may already be resolving in the worker,
+    # hence the race-tolerant bound).
+    assert len(engine.state.committed_seals) == 3
+    assert 3 <= verifier.seal_lanes <= 4
+    # the deferred lane resolves off-path through the speculator...
+    assert speculator.drain(10.0)
+    assert speculator.speculated_lanes == 1
+    assert verifier.seal_lanes == 4
+    # ...and a repeat drain sees it as a cache hit (all 4 now valid)
+    valid = engine._drain_valid_commits(view)
+    assert len(valid) == 4
+    assert verifier.seal_lanes == 4  # no new crypto
+    speculator.stop()
+
+
+def test_quarantine_evicts_cache_entry():
+    engine, verifier, backends = _engine()
+    view, proposer, phash = _accept(engine, backends)
+    other = next(b for b in backends if b is not proposer)
+    commit = other.build_commit_message(phash, view)
+    seal = extract_committed_seal(commit)
+    engine.add_messages([commit])
+    assert engine.speculator.drain(10.0)
+    assert (
+        engine.speculator.lookup_seal(
+            1, 0, phash, other.address, seal.signature
+        )
+        is True
+    )
+    engine.speculator.quarantine_seals(
+        1, 0, phash, [(other.address, seal)]
+    )
+    assert (
+        engine.speculator.lookup_seal(
+            1, 0, phash, other.address, seal.signature
+        )
+        is None
+    )
+    engine.speculator.stop()
+
+
+def test_sequence_reset_pins_live_view():
+    engine, verifier, backends = _engine()
+    spec = engine.speculator
+    spec.cache.store(
+        1, 0, b"\xee" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65, True
+    )
+    spec.cache.store(
+        7, 0, b"\xee" * 32, PHASE_COMMIT_SEAL, b"s" * 20, b"g" * 65, True
+    )
+    spec.note_view(5, 0)
+    assert (
+        spec.lookup_seal(1, 0, b"\xee" * 32, b"s" * 20, b"g" * 65) is None
+    )
+    assert (
+        spec.lookup_seal(7, 0, b"\xee" * 32, b"s" * 20, b"g" * 65) is True
+    )
+    spec.stop()
+
+
+# -- worker robustness --------------------------------------------------
+
+
+class _FaultingVerifier:
+    def __init__(self):
+        self.calls = 0
+
+    def verify_committed_seals(self, proposal_hash, seals, height):
+        self.calls += 1
+        raise RuntimeError("boom")
+
+
+def test_worker_fault_is_best_effort():
+    faulty = _FaultingVerifier()
+    spec = SpeculativeVerifier(faulty)
+    keys = [PrivateKey.from_seed(b"f-%d" % i) for i in range(2)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    b = ECDSABackend(keys[0], src)
+    view = View(height=1, round=0)
+    commit = b.build_commit_message(b"\xab" * 32, view)
+    assert spec.submit_commit_messages([commit]) == 1
+    assert spec.drain(10.0)
+    assert spec.faults == 1
+    assert len(spec.cache) == 0  # no verdict stored on a fault
+    spec.stop()
+
+
+def test_bounded_queue_drops_overflow():
+    gate = threading.Event()
+
+    class _Blocking:
+        def verify_committed_seals(self, proposal_hash, seals, height):
+            gate.wait(10.0)
+            return np.ones(len(seals), dtype=bool)
+
+    spec = SpeculativeVerifier(_Blocking(), max_queue_lanes=2)
+    keys = [PrivateKey.from_seed(b"q-%d" % i) for i in range(4)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    view = View(height=1, round=0)
+    backends = [ECDSABackend(k, src) for k in keys]
+    sent = 0
+    for b in backends:
+        sent += spec.submit_seal_lanes(
+            1,
+            0,
+            b"\xcd" * 32,
+            [
+                (
+                    b.address,
+                    extract_committed_seal(
+                        b.build_commit_message(b"\xcd" * 32, view)
+                    ),
+                )
+            ],
+        )
+    assert sent <= 2
+    assert spec.dropped_lanes >= 2
+    gate.set()
+    spec.drain(10.0)
+    spec.stop()
+
+
+def test_submit_dedups_against_cache():
+    engine, verifier, backends = _engine()
+    view, proposer, phash = _accept(engine, backends)
+    other = next(b for b in backends if b is not proposer)
+    commit = other.build_commit_message(phash, view)
+    engine.speculator.submit_commit_messages([commit])
+    assert engine.speculator.drain(10.0)
+    lanes = engine.speculator.speculated_lanes
+    # resubmitting the identical message queues nothing
+    assert engine.speculator.submit_commit_messages([commit]) == 0
+    assert engine.speculator.speculated_lanes == lanes
+    engine.speculator.stop()

@@ -2,7 +2,8 @@
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
-	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke
+	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
+	serve-bench
 
 test:
 	python -m pytest tests/ -q
@@ -55,6 +56,15 @@ tenant-bench:
 latency-smoke:
 	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=600 \
 	python bench.py --latency-only
+
+# Light-client proof serving (config #12): cold/warm ProofCache, M
+# concurrent clients through the coalesced read plane vs per-client
+# sequential verification, and the consensus-vs-proof-flood QoS bound.
+# Fast-tier CI entry; lane verdicts oracle-gated before timing.
+# GO_IBFT_SERVE_CLIENTS overrides the client count.
+serve-bench:
+	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --serve-only
 
 # Multi-tenant fairness soak: hot + slow chains sharing one scheduler
 # under seeded chaos (tests/test_sched_consensus.py, slow tier included)

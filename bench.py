@@ -24,6 +24,14 @@ Configs (BASELINE.json):
       and the aggregation-tree dissemination wire model (fan-in, per-node
       bytes vs flooding); device branch times the pairing kernel at
       100/300/1000 validators
+  #10 multi-tenant coalesced consensus: 8 concurrent chains through ONE
+      TenantScheduler vs the same chains serial; `--tenant-only`
+  #11 commit critical path: accept->finalize p50/p99 with speculation +
+      quorum early-exit ON vs OFF; `--latency-only`
+  #12 light-client proof serving: cold/warm ProofCache, coalesced
+      multi-client verification vs per-client-sequential, and the
+      consensus-vs-proof-flood QoS bound (read-tier tenancy);
+      `--serve-only` (the `make serve-bench` path)
 
 Prints one JSON line per config; the HEADLINE line (config #2, the
 ``{"metric", "value", "unit", "vs_baseline"}`` schema) is printed LAST on
@@ -1908,6 +1916,409 @@ def config11_commit_critical_path() -> None:
     )
 
 
+class _ListSyncSource:
+    """List-backed SyncSource over a prebuilt finalized chain (shared by
+    config #12's serving and QoS phases)."""
+
+    def __init__(self, blocks):
+        self._blocks = blocks
+
+    def latest_height(self):
+        return self._blocks[-1].height
+
+    def get_blocks(self, start, end):
+        return [b for b in self._blocks if start <= b.height <= end]
+
+
+def config12_proof_serving() -> None:
+    """Batched light-client proof serving (config #12, ISSUE 10).
+
+    The first read-heavy workload: a finalized 100-validator chain
+    (scaled down without the native verifier) serves finality proofs
+    (header + quorum seals + validator-set diff chain) to a many-client
+    traffic generator through ``go_ibft_tpu/serve/`` — the canonical-
+    range ProofCache, the shared sig-verdict cache, and the scheduler
+    read tier.  Four phases:
+
+    * **oracle gate (before any timing)** — every proof in the request
+      schedule verifies through the serve plane AND against the
+      sequential per-lane oracle (the native C++ sequential loop when
+      present — config #2's baseline shape — else the pure-Python
+      ``HostBatchVerifier``); masks must agree lane for lane, and a
+      tampered proof must be rejected by both.
+    * **cold vs warm cache** — the same K-request schedule against a
+      fresh server (chunk builds + pre-serve self-check on the clock)
+      and again against the warm cache; acceptance: warm >= 5x cold
+      proofs/s.
+    * **coalesced vs per-client-sequential** — M concurrent clients
+      verify full-range proofs through the SHARED read plane (sig-
+      verdict cache + scheduler read tenant) vs the same M
+      verifications run per-client sequentially with NO sharing (each
+      its own bulk sequential verifier — the world before this PR);
+      coalesced runs FIRST so warm bias favors the baseline;
+      acceptance: >= 1.5x.
+    * **QoS** — a live 4-validator consensus chain (consensus tier)
+      finalizes under a concurrent proof-verify flood (read tier) on
+      the SAME scheduler; acceptance: the chain misses ZERO heights.
+    """
+    import threading as _threading
+
+    from go_ibft_tpu import native
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.chain.wal import FinalizedBlock
+    from go_ibft_tpu.core.validator_manager import calculate_quorum
+    from go_ibft_tpu.crypto import ecdsa as _ec
+    from go_ibft_tpu.crypto.backend import (
+        ECDSABackend,
+        encode_signature,
+        proposal_hash_of,
+    )
+    from go_ibft_tpu.messages.helpers import CommittedSeal
+    from go_ibft_tpu.messages.wire import Proposal
+    from go_ibft_tpu.sched import TenantScheduler
+    from go_ibft_tpu.serve import (
+        ProofBuilder,
+        ProofCache,
+        ProofError,
+        ProofServer,
+        ProofVerifier,
+        SigVerdictCache,
+        any_signer_source,
+    )
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    have_native = native.load() is not None
+    n = _host_scale(100, 4)
+    heights = 4
+    chunk_heights = 2
+    clients = int(
+        os.environ.get("GO_IBFT_SERVE_CLIENTS", "24" if have_native else "4")
+    )
+    # Route policy matches config #10: host on CPU fallback (auto's
+    # device cutover would time cold XLA:CPU compiles, not serving),
+    # auto on a real device.
+    sched_route = "host" if _FALLBACK else "auto"
+
+    keys = _keys(n, seed=77)
+    powers = {k.address: 1 for k in keys}
+    src = ECDSABackend.static_validators(powers)
+    quorum = calculate_quorum(n)
+
+    # Finalized chain: exactly-quorum seal sets (the steady-state WAL
+    # shape) — signing is the expensive part on the pure-Python path, so
+    # only the quorum signs.
+    blocks = []
+    for h in range(1, heights + 1):
+        proposal = Proposal(raw_proposal=b"serve bench block %d" % h, round=0)
+        phash = proposal_hash_of(proposal)
+        blocks.append(
+            FinalizedBlock(
+                h,
+                proposal,
+                [
+                    CommittedSeal(
+                        signer=k.address,
+                        signature=encode_signature(*_ec.sign(k, phash)),
+                    )
+                    for k in keys[:quorum]
+                ],
+            )
+        )
+
+    lanes_per_proof = heights * quorum
+
+    def _oracle_mask(lanes) -> np.ndarray:
+        """The sequential reference semantics per lane (native C++ loop
+        when present — the config #2 baseline shape — else pure Python)."""
+        if have_native:
+            table = [k.address for k in keys]
+            return native.verify_batch_sequential(
+                [ph for ph, _s in lanes],
+                [s.signature for _ph, s in lanes],
+                [s.signer for _ph, s in lanes],
+                table,
+            )
+        oracle = HostBatchVerifier(src)
+        return np.asarray(oracle.verify_seal_lanes(list(lanes), 1), dtype=bool)
+
+    # K-request schedule: overlapping checkpoints over the chain (what a
+    # mixed client population asks for), shared by the cold and warm
+    # passes byte-identically.
+    schedule = [
+        (0, heights),
+        (0, heights),
+        (1, heights),
+        (2, heights),
+        (0, chunk_heights),
+        (1, heights - 1),
+        (chunk_heights, heights),
+        (0, heights),
+    ]
+
+    class _BulkLanes:
+        """The serve plane's production host drain shape: one bulk
+        sequential call over the claimed-signer table (sig validity only
+        — the sched/dispatch.py membership split), pure-Python recovers
+        without the native library."""
+
+        def verify_seal_lanes(self, lanes, height):
+            if have_native:
+                return native.verify_batch_sequential(
+                    [ph for ph, _s in lanes],
+                    [s.signature for _ph, s in lanes],
+                    [s.signer for _ph, s in lanes],
+                    list(dict.fromkeys(s.signer for _ph, s in lanes)),
+                )
+            return HostBatchVerifier(any_signer_source).verify_seal_lanes(
+                lanes, height
+            )
+
+    class _RecordingLanes(_BulkLanes):
+        """Lane verifier shim recording fresh-drain masks (the per-lane
+        oracle-gate surface) on top of the plane's bulk host route."""
+
+        def __init__(self):
+            self.lanes = []
+            self.masks = []
+
+        def verify_seal_lanes(self, lanes, height):
+            mask = super().verify_seal_lanes(lanes, height)
+            self.lanes.extend(lanes)
+            self.masks.extend(np.asarray(mask, dtype=bool).tolist())
+            return mask
+
+    def _oracle_gate() -> None:
+        recording = _RecordingLanes()
+        verifier = ProofVerifier(lane_verifier=recording)
+        builder = ProofBuilder(_ListSyncSource(blocks), src)
+        for checkpoint, target in schedule:
+            proof = builder.build(checkpoint, target)
+            verifier.verify(proof, src(checkpoint + 1))  # accepts
+        assert recording.lanes, "oracle gate saw no lanes"
+        expected = _oracle_mask(recording.lanes)
+        got = np.asarray(recording.masks, dtype=bool)
+        assert (got == np.asarray(expected, dtype=bool)[: len(got)]).all(), (
+            "serve-plane lane verdicts diverged from the sequential oracle"
+        )
+        # a tampered proof is rejected by the plane AND by the oracle
+        tampered = builder.build(0, heights)
+        bad = []
+        for i, seal in enumerate(tampered.entries[0].seals):
+            sig = seal.signature
+            if i < quorum:  # flip every quorum seal: unambiguously short
+                sig = sig[:5] + bytes([sig[5] ^ 0xFF]) + sig[6:]
+            bad.append(CommittedSeal(seal.signer, sig))
+        tampered.entries[0].seals[:] = bad
+        try:
+            ProofVerifier(lane_verifier=_BulkLanes()).verify(tampered, src(1))
+        except ProofError:
+            pass
+        else:
+            raise AssertionError("tampered proof was accepted")
+        phash = proposal_hash_of(tampered.entries[0].proposal)
+        assert not _oracle_mask([(phash, s) for s in bad]).any()
+
+    _oracle_gate()
+
+    # -- phase 1+2: cold vs warm cache ---------------------------------
+    sched = TenantScheduler(window_s=0.002, route=sched_route)
+    with sched:
+        server = ProofServer(
+            ProofBuilder(_ListSyncSource(blocks), src),
+            ProofCache(chunk_heights=chunk_heights),
+            scheduler=sched,
+        )
+
+        def _timed_pass() -> float:
+            t0 = time.perf_counter()
+            for checkpoint, target in schedule:
+                server.get_proof(checkpoint, target)
+            return time.perf_counter() - t0
+
+        cold_s = _timed_pass()
+        warm_s = _timed_pass()
+        cold_pps = len(schedule) / cold_s
+        warm_pps = len(schedule) / warm_s
+        cache_stats = server.cache.stats()
+
+        # -- phase 3: coalesced vs per-client-sequential ----------------
+        proof = server.get_proof(0, heights)
+        errors: list = []
+
+        def _coalesced_client():
+            try:
+                server.verify_proof(proof, src(1))
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        t0 = time.perf_counter()
+        threads = [
+            _threading.Thread(target=_coalesced_client) for _ in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        coalesced_s = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"coalesced client failed: {errors[0]!r}")
+        serve_stats = server.stats()
+        sched_stats = sched.stats()
+
+        # Baseline AFTER (warm bias favors it): the same M verifications
+        # with NO shared plane — each client re-verifies every lane of
+        # its own proof through its own bulk sequential verifier.
+        t0 = time.perf_counter()
+        for _ in range(clients):
+            ProofVerifier(
+                lane_verifier=_BulkLanes(), sig_cache=SigVerdictCache()
+            ).verify(proof, src(1))
+        per_client_s = time.perf_counter() - t0
+
+        # -- phase 4: QoS — live chain under a proof flood --------------
+        qos = _config12_qos_phase(sched, blocks, src)
+        server.close()
+
+    coalesced_pps = clients / coalesced_s
+    per_client_pps = clients / per_client_s
+    _log(
+        {
+            "metric": config12_proof_serving.metric,
+            "value": round(coalesced_pps, 2),
+            "unit": "proofs/s",
+            "vs_baseline": round(coalesced_pps / per_client_pps, 2),
+            "baseline": (
+                "same client schedule, per-client sequential verification "
+                "(no shared cache, no coalescing)"
+            ),
+            "validators": n,
+            "heights": heights,
+            "quorum": quorum,
+            "clients": clients,
+            "lanes_per_proof": lanes_per_proof,
+            "cold_proofs_per_s": round(cold_pps, 2),
+            "warm_proofs_per_s": round(warm_pps, 2),
+            "warm_over_cold": round(warm_pps / cold_pps, 2),
+            "coalesced_proofs_per_s": round(coalesced_pps, 2),
+            "per_client_proofs_per_s": round(per_client_pps, 2),
+            "coalesce_speedup": round(coalesced_pps / per_client_pps, 2),
+            "cache_hit_rate": cache_stats["hit_rate"],
+            "cache_chunks": cache_stats["chunks"],
+            "sig_cache_hit_rate": serve_stats["verify"]["sig_cache"][
+                "hit_rate"
+            ],
+            "sig_cache_hits": serve_stats["verify"]["sig_cache"]["hits"],
+            "sched_dispatches": sched_stats["dispatches"],
+            "sched_coalesce_ratio": sched_stats["coalesce_ratio"],
+            "qos": qos,
+            "oracle_exact": True,
+            "native_verify": have_native,
+            "route": sched_route,
+        }
+    )
+
+
+def _config12_qos_phase(sched, flood_blocks, flood_src) -> dict:
+    """Config #12's QoS bound: a real-crypto 4-validator chain on the
+    consensus tier finalizes every height while a proof flood hammers the
+    read tier of the SAME scheduler.  Returns the evidence sub-record;
+    raises when the chain missed a height (the acceptance is a hard
+    bound, not a statistic)."""
+    import asyncio
+    import threading as _threading
+
+    from go_ibft_tpu.chain import ChainRunner
+    from go_ibft_tpu.core import IBFT, BatchingIngress
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.serve import ProofBuilder, ProofCache, ProofServer
+
+    class _Null:
+        def info(self, *a):
+            pass
+
+        debug = error = info
+
+    heights = 2
+    stop = _threading.Event()
+    flood_proofs = [0]
+    flood_errors: list = []
+
+    def flood():
+        server = ProofServer(
+            ProofBuilder(_ListSyncSource(flood_blocks), flood_src),
+            ProofCache(chunk_heights=2),
+            scheduler=sched,
+        )
+        try:
+            while not stop.is_set():
+                # fresh sig cache per pass: every iteration drives REAL
+                # lanes through the read tier, not warm lookups
+                server.verifier.sig_cache.clear()
+                proof = server.get_proof(0)
+                server.verify_proof(proof, flood_src(1))
+                flood_proofs[0] += 1
+        except BaseException as err:  # noqa: BLE001 - surfaced below
+            flood_errors.append(err)
+        finally:
+            server.close()
+
+    async def drive_chain() -> list:
+        keys = [PrivateKey.from_seed(b"c12-qos-%d" % i) for i in range(4)]
+        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        nodes, runners = [], []
+
+        class _T:
+            def multicast(self, message):
+                for ingress in nodes:
+                    ingress.submit(message)
+
+        for i, key in enumerate(keys):
+            handle = sched.register(
+                f"c12-qos/n{i}", src, chain_id="c12-qos"
+            )
+            core = IBFT(
+                _Null(), ECDSABackend(key, src), _T(), batch_verifier=handle
+            )
+            core.set_base_round_timeout(30.0)
+            nodes.append(BatchingIngress(core.add_messages))
+            runners.append(ChainRunner(core, overlap=False))
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(
+                    *(r.run(until_height=heights) for r in runners)
+                ),
+                180.0,
+            )
+        finally:
+            for runner, ingress in zip(runners, nodes):
+                ingress.close()
+                runner.engine.messages.close()
+        return [r.latest_height() for r in runners]
+
+    flood_thread = _threading.Thread(target=flood, daemon=True)
+    flood_thread.start()
+    try:
+        finalized = asyncio.run(drive_chain())
+    finally:
+        stop.set()
+        flood_thread.join(60.0)
+    if flood_errors:
+        raise RuntimeError(f"proof flood failed: {flood_errors[0]!r}")
+    missed = sum(heights - f for f in finalized)
+    if missed:
+        raise AssertionError(
+            f"consensus chain missed {missed} heights under the proof "
+            f"flood (finalized {finalized}, expected {heights} each)"
+        )
+    return {
+        "chain_heights": heights,
+        "chain_nodes": len(finalized),
+        "missed_heights": 0,
+        "flood_proofs": flood_proofs[0],
+    }
+
+
 def config2_host_fallback() -> None:
     """Config #2 CPU-fallback variant: whole-round verify on the host route.
 
@@ -2156,6 +2567,7 @@ config8_mesh.metric = "mesh_sharded_drain_8k_100v"
 config9_aggregate.metric = "aggregate_commit_cert_100v"
 config10_multitenant.metric = "multi_tenant_blocks_per_s"
 config11_commit_critical_path.metric = "commit_critical_path_100v"
+config12_proof_serving.metric = "proof_serving_100v"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -2172,29 +2584,31 @@ config2_host_fallback.metric = headline_metric(True)
 # and must stay the final parsed line); the headline runs last on a live
 # chip (guarded separately in _run).
 _FALLBACK_SCHEDULE = (
-    (config3_host_scaled, 270.0),
-    (config4_host_scaled, 220.0),
-    (config5_host_scaled, 190.0),
-    (config6_chaos, 165.0),
-    (config7_chain, 125.0),
-    (config8_mesh, 115.0),
-    (config9_aggregate, 85.0),
-    (config10_multitenant, 45.0),
-    (config11_commit_critical_path, 35.0),
+    (config3_host_scaled, 300.0),
+    (config4_host_scaled, 250.0),
+    (config5_host_scaled, 220.0),
+    (config6_chaos, 195.0),
+    (config7_chain, 155.0),
+    (config8_mesh, 145.0),
+    (config9_aggregate, 115.0),
+    (config10_multitenant, 75.0),
+    (config11_commit_critical_path, 65.0),
+    (config12_proof_serving, 35.0),
     (config2_host_fallback, 30.0),
     (config1_happy_path, 0.0),
 )
 _DEVICE_SCHEDULE = (
-    (config1_happy_path, 570.0),
-    (config3_pipelined, 510.0),
-    (config4_bls, 450.0),
-    (config5_byzantine_mix, 410.0),
-    (config6_chaos, 390.0),
-    (config7_chain, 370.0),
-    (config8_mesh, 360.0),
-    (config9_aggregate, 340.0),
-    (config10_multitenant, 310.0),
-    (config11_commit_critical_path, 300.0),
+    (config1_happy_path, 600.0),
+    (config3_pipelined, 540.0),
+    (config4_bls, 480.0),
+    (config5_byzantine_mix, 440.0),
+    (config6_chaos, 420.0),
+    (config7_chain, 400.0),
+    (config8_mesh, 390.0),
+    (config9_aggregate, 370.0),
+    (config10_multitenant, 340.0),
+    (config11_commit_critical_path, 330.0),
+    (config12_proof_serving, 300.0),
 )
 
 
@@ -2265,6 +2679,15 @@ def main(argv=None) -> None:
         help="run ONLY the commit-critical-path config (#11); the rc=0 "
         "evidence contract scopes to it (the `make latency-smoke` entry "
         "point — speculation + early-exit on vs off on the host route)",
+    )
+    parser.add_argument(
+        "--serve-only",
+        action="store_true",
+        help="run ONLY the proof-serving config (#12); the rc=0 evidence "
+        "contract scopes to it (the `make serve-bench` entry point — "
+        "cold/warm cache, coalesced vs per-client clients, and the "
+        "consensus-vs-proof-flood QoS bound on the host route; "
+        "GO_IBFT_SERVE_CLIENTS overrides the client count)",
     )
     args = parser.parse_args(argv)
     if args.trace:
@@ -2349,6 +2772,20 @@ def _run(args) -> None:
         failures = []
         _guarded(config11_commit_critical_path, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config11_commit_critical_path.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.serve_only:
+        # Scoped run for `make serve-bench`: only config #12, rc=0 iff
+        # its evidence line landed.  The config oracle-gates every
+        # scheduled proof's lane verdicts (and a tamper rejection)
+        # itself before timing anything.
+        failures = []
+        _guarded(config12_proof_serving, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config12_proof_serving.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

@@ -290,6 +290,77 @@ async def main_chain(
     _print_chains(engines)
 
 
+def main_serve(n: int, heights: int, clients: int) -> None:
+    """Proof-serving mode (``--serve N``): run a chain to finality, then
+    serve finality proofs to N synthetic light clients.
+
+    The chain side is ``--chain`` in miniature (one ChainRunner per
+    validator, no WAL — the serve layer reads the in-memory chain tail
+    through the runner's ``SyncSource`` seam); the read side mounts a
+    :class:`~go_ibft_tpu.serve.ProofServer` on runner 0 and hammers it
+    from N client threads, each verifying its proof against the trusted
+    genesis checkpoint.  Prints proofs/s and the cache hit rates — the
+    docs/SERVING.md read-plane story at toy scale.
+    """
+    import threading
+    import time
+
+    from go_ibft_tpu.chain import ChainRunner
+    from go_ibft_tpu.serve import ProofBuilder, ProofCache, ProofServer
+
+    engines, _certifier, _hub = build_cluster(n, use_device=False)
+    runners = [ChainRunner(engine, overlap=False) for engine in engines]
+
+    async def drive() -> None:
+        await asyncio.gather(*(r.run(until_height=heights) for r in runners))
+
+    try:
+        asyncio.run(drive())
+    finally:
+        for engine in engines:
+            engine.messages.close()
+    _print_chains(engines)
+
+    source = runners[0]  # ChainRunner IS a SyncSource
+    server = ProofServer(
+        ProofBuilder(source, source.validators_for_height),
+        ProofCache(chunk_heights=2),
+    )
+    verified = []
+    t0 = time.perf_counter()
+
+    def client(i: int) -> None:
+        # staggered checkpoints: overlapping ranges share cached chunks
+        checkpoint = i % max(1, heights - 1)
+        proof = server.get_proof(checkpoint)
+        # the trust anchor is the CHECKPOINT's next-height set — a client
+        # must never verify against a set its checkpoint does not vouch
+        # for (matters the moment the validator set rotates)
+        server.verify_proof(
+            proof, source.validators_for_height(checkpoint + 1)
+        )
+        verified.append(proof.target)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    stats = server.stats()
+    print(
+        f"served+verified {len(verified)} proofs in {elapsed * 1e3:.1f} ms "
+        f"({len(verified) / elapsed:.1f} proofs/s)"
+    )
+    print(
+        f"proof cache: {stats['cache']['hits']} hits / "
+        f"{stats['cache']['misses']} misses "
+        f"(hit rate {stats['cache']['hit_rate']}), "
+        f"sig-verdict cache hit rate "
+        f"{stats['verify']['sig_cache']['hit_rate']}"
+    )
+
+
 def main_tenants(n: int, heights: int, tenants: int) -> None:
     """Multi-tenant mode (``--tenants N``): N independent chains — their
     own validator sets, proposals and WALs — share ONE process-wide
@@ -454,8 +525,20 @@ if __name__ == "__main__":
         "wide TenantScheduler (docs/TENANCY.md); prints per-tenant drain "
         "p99 and the coalesce ratio",
     )
+    ap.add_argument(
+        "--serve",
+        type=int,
+        default=0,
+        metavar="N",
+        help="proof-serving mode: finalize --heights blocks, then serve "
+        "finality proofs to N synthetic light clients through a "
+        "ProofServer mounted on the chain (docs/SERVING.md); prints "
+        "proofs/s and cache hit rates",
+    )
     args = ap.parse_args()
-    if args.tenants:
+    if args.serve:
+        main_serve(args.nodes, args.heights, args.serve)
+    elif args.tenants:
         main_tenants(args.nodes, args.heights, args.tenants)
     else:
         runner = main_chain if args.chain else main_async

@@ -157,6 +157,17 @@ class ChainRunner:
     def latest_height(self) -> int:
         return self.chain[-1].height if self.chain else 0
 
+    def validators_for_height(self, height: int) -> dict:
+        """Per-height validator-set snapshot (the proof-serving seam).
+
+        The serve layer (:mod:`go_ibft_tpu.serve`) builds finality proofs
+        from a ``SyncSource`` plus this snapshot source — a
+        ``ProofBuilder(runner, runner.validators_for_height)`` mounts a
+        running node unchanged, rotation-aware: the engine backend's
+        ``get_voting_powers`` is already the height-keyed seam every
+        verifier uses."""
+        return self.engine.backend.get_voting_powers(height)
+
     def get_blocks(self, start: int, end: int) -> List[FinalizedBlock]:
         # The in-memory tail is contiguous ascending, so a range request
         # is an index slice, not a scan (peers poll this at sync cadence).

@@ -13,6 +13,7 @@ from .scheduler import (
     DISPATCHES_KEY,
     DRAIN_MS_KEY,
     FLUSH_FAULTS_KEY,
+    PRIORITY_RANK,
     QUEUE_LANES_KEY,
     SHED_LANES_KEY,
     SchedQueueFull,
@@ -22,6 +23,7 @@ from .scheduler import (
 
 __all__ = [
     "CoalescedDispatcher",
+    "PRIORITY_RANK",
     "SchedQueueFull",
     "TenantScheduler",
     "TenantVerifierHandle",

@@ -24,6 +24,15 @@ module lifts the verify data plane to PROCESS scope:
   dispatch by DRR over tenants (per-flush quantum, deficits capped), so a
   hot 100-validator tenant cannot crowd a 4-validator one out of the
   device;
+* **priority classes (read-tier QoS, ISSUE 10)**: tenants register as
+  ``"consensus"`` (default) or ``"read"``; selection is class-ordered —
+  the oldest queued CONSENSUS request always ships first and consensus
+  tenants fill the dispatch before any read-tier lane is considered, so
+  the proof-serving read plane (:mod:`go_ibft_tpu.serve`) can flood the
+  scheduler without ever starving a live round.  Within a class the
+  oldest-first + DRR guarantees above hold unchanged; read lanes ride in
+  whatever capacity consensus left unused (dispatches are 2048 lanes —
+  consensus rounds rarely fill them);
 * **per-chain backpressure**: each tenant's queue is bounded in lanes; a
   wedged or flooding tenant sheds load at SUBMIT time — the handle serves
   those verdicts from its local host oracle (exact, slower) — and the
@@ -70,6 +79,7 @@ from .dispatch import (
 )
 
 __all__ = [
+    "PRIORITY_RANK",
     "SchedQueueFull",
     "TenantScheduler",
     "TenantVerifierHandle",
@@ -87,6 +97,12 @@ DISPATCHES_KEY = ("go-ibft", "sched", "dispatches")
 COALESCED_REQUESTS_KEY = ("go-ibft", "sched", "coalesced_requests")
 DRAIN_MS_KEY = ("go-ibft", "sched", "drain_ms")
 FLUSH_FAULTS_KEY = ("go-ibft", "sched", "flush_faults")
+
+
+# Tenant QoS classes: lower rank is selected first (ISSUE 10).  Consensus
+# traffic (live rounds, chain sync, overlap drains) outranks the
+# proof-serving read tier by construction — see _select_locked.
+PRIORITY_RANK = {"consensus": 0, "read": 1}
 
 
 class SchedQueueFull(RuntimeError):
@@ -178,10 +194,13 @@ class _Tenant:
         chain_id: str,
         validators: Callable[[int], Mapping[bytes, int]],
         calibrator=None,
+        priority: str = "consensus",
     ):
         self.tid = tid
         self.chain_id = chain_id
         self.validators = validators
+        self.priority = priority
+        self.rank = PRIORITY_RANK[priority]
         # Per-tenant arrival model (ISSUE 9): EWMA inter-arrival rate,
         # summed across active tenants to project how fast the shared
         # dispatch will fill — the calibrated replacement for the fixed
@@ -318,10 +337,20 @@ class TenantScheduler:
         validators_for_height: Callable[[int], Mapping[bytes, int]],
         *,
         chain_id: Optional[str] = None,
+        priority: str = "consensus",
     ) -> "TenantVerifierHandle":
         """Register one tenant (typically one engine of one chain) and
         return its scheduler-backed verifier handle.  ``chain_id`` labels
-        the chain for stats aggregation (defaults to the tenant id)."""
+        the chain for stats aggregation (defaults to the tenant id).
+        ``priority`` is the QoS class: ``"consensus"`` (default) for live
+        rounds, ``"read"`` for the proof-serving plane — read lanes only
+        fill dispatch capacity consensus left unused, so a proof flood
+        can never starve a finalizing chain."""
+        if priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"unknown priority {priority!r} "
+                f"(expected one of {sorted(PRIORITY_RANK)})"
+            )
         with self._cv:
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} already registered")
@@ -336,6 +365,7 @@ class TenantScheduler:
                     if self.calibrate
                     else None
                 ),
+                priority=priority,
             )
             self._tenants[tenant_id] = tenant
             self._rr.append(tenant_id)
@@ -467,12 +497,18 @@ class TenantScheduler:
     def _select_locked(self) -> List[_Request]:
         """Pick one dispatch's worth of requests.
 
-        The globally OLDEST queued request always ships first — the hard
-        starvation bound: a request is never passed over in favor of
-        younger traffic, so its wait is bounded by the backlog that
-        existed when it was queued (itself bounded by the per-tenant
-        queue caps).  The remaining capacity fills by deficit round
-        robin: each non-empty tenant earns ``quantum_lanes`` per flush
+        Selection is CLASS-ORDERED first (read-tier QoS, ISSUE 10): the
+        oldest queued request of the highest-priority class with queued
+        work always ships first, and lower classes only fill capacity the
+        higher ones left unused — so the consensus starvation bound is
+        hard (a proof flood adds at most one in-flight flush of latency,
+        never a queueing delay), while read traffic still drains through
+        the spare lanes of every dispatch.
+
+        Within a class the prior guarantees hold: the oldest queued
+        request is never passed over in favor of younger same-class
+        traffic, and the remaining capacity fills by deficit round robin
+        — each non-empty tenant earns ``quantum_lanes`` per flush
         (capped at one dispatch) and spends it on whole requests, so
         lane-hungry tenants cannot monopolize consecutive flushes."""
         batch: List[_Request] = []
@@ -491,27 +527,42 @@ class TenantScheduler:
             batch.append(req)
             return req
 
-        oldest_tenant = min(active, key=lambda t: t.queue[0].submitted_at)
+        top_rank = min(t.rank for t in active)
+        oldest_tenant = min(
+            (t for t in active if t.rank == top_rank),
+            key=lambda t: t.queue[0].submitted_at,
+        )
         take(oldest_tenant)
         n = len(self._rr)
-        for k in range(n):
-            tid = self._rr[(self._rr_next + k) % n]
-            tenant = self._tenants[tid]
-            if not tenant.queue:
-                tenant.deficit = 0
-                continue
-            tenant.deficit = min(
-                tenant.deficit + self.quantum_lanes, self.max_dispatch_lanes
-            )
-            while (
-                tenant.queue
-                and lanes + tenant.queue[0].lanes <= self.max_dispatch_lanes
-                and tenant.deficit >= tenant.queue[0].lanes
-            ):
-                tenant.deficit -= tenant.queue[0].lanes
-                take(tenant)
+        for class_rank in sorted({t.rank for t in self._tenants.values()}):
+            for k in range(n):
+                tid = self._rr[(self._rr_next + k) % n]
+                tenant = self._tenants[tid]
+                if tenant.rank != class_rank:
+                    continue
+                if not tenant.queue:
+                    tenant.deficit = 0
+                    continue
+                tenant.deficit = min(
+                    tenant.deficit + self.quantum_lanes, self.max_dispatch_lanes
+                )
+                while (
+                    tenant.queue
+                    and lanes + tenant.queue[0].lanes <= self.max_dispatch_lanes
+                    and tenant.deficit >= tenant.queue[0].lanes
+                ):
+                    tenant.deficit -= tenant.queue[0].lanes
+                    take(tenant)
+                if lanes >= self.max_dispatch_lanes:
+                    break
             if lanes >= self.max_dispatch_lanes:
                 break
+        # Idle tenants reset their deficit even when a full dispatch cut
+        # the walk short of visiting them — the documented no-banked-
+        # credit invariant must not depend on loop reachability.
+        for tenant in self._tenants.values():
+            if not tenant.queue:
+                tenant.deficit = 0
         if n:
             self._rr_next = (self._rr_next + 1) % n
         metrics.set_gauge(QUEUE_LANES_KEY, float(self._pending_lanes))
@@ -604,6 +655,7 @@ class TenantScheduler:
                 requests, lanes = t.requests, t.lanes
             return {
                 "chain": t.chain_id,
+                "priority": t.priority,
                 "queue_lanes": t.queued_lanes,
                 "requests": requests,
                 "lanes": lanes,

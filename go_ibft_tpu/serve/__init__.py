@@ -1,0 +1,48 @@
+"""Light-client proof serving: the read-heavy front end over ChainRunner.
+
+Layer map (docs/SERVING.md):
+
+* :mod:`go_ibft_tpu.serve.proof` — proof data model + builder
+  (:class:`FinalityProof` = headers + quorum evidence + validator-set
+  diff chain from a trusted checkpoint);
+* :mod:`go_ibft_tpu.serve.cache` — canonical height-range chunk cache
+  (:class:`ProofCache`: irreversible finality makes hot proofs a
+  lookup);
+* :mod:`go_ibft_tpu.serve.server` — :class:`ProofServer` (cache +
+  stampede coalescing + pre-serve self-check) and
+  :class:`ProofVerifier` (client-side verification with a shared
+  signature-verdict cache and scheduler-coalesced fresh drains).
+"""
+
+from .cache import CachedChunk, ProofCache
+from .proof import (
+    FinalityProof,
+    ProofBuilder,
+    ProofEntry,
+    ProofError,
+    SetDiff,
+    diff_chain,
+    walk_sets,
+)
+from .server import (
+    ProofServer,
+    ProofVerifier,
+    SigVerdictCache,
+    any_signer_source,
+)
+
+__all__ = [
+    "CachedChunk",
+    "FinalityProof",
+    "ProofBuilder",
+    "ProofCache",
+    "ProofEntry",
+    "ProofError",
+    "ProofServer",
+    "ProofVerifier",
+    "SetDiff",
+    "SigVerdictCache",
+    "any_signer_source",
+    "diff_chain",
+    "walk_sets",
+]

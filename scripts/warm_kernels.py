@@ -109,6 +109,18 @@ def main() -> None:
     DeviceBatchVerifier(lambda h: {}).warmup(lanes=(8, 128), table_rows=128)
     _stamp("early-exit drain shapes (8/128 lanes x 128-row table)", t0)
 
+    # Serve-path drain shapes (ISSUE 10): the proof-serving read plane's
+    # device route is the multi-tenant CoalescedDispatcher — fresh proof
+    # lanes coalesce into the SAME pinned recover/digest programs at the
+    # claimed-signer-table shapes ((8, 8) for the tier-1 suites, (128,
+    # 128) for a 100-validator quorum drain).  Cold-compiling either
+    # inside a test or bench timeout is the failure mode warmed here.
+    t0 = time.perf_counter()
+    from go_ibft_tpu.sched import CoalescedDispatcher
+
+    CoalescedDispatcher(route="device").warmup(lanes=(8, 128), table_rows=128)
+    _stamp("serve/sched coalesced drain shapes (8/128 lanes)", t0)
+
     for n in _sizes():
         t0 = time.perf_counter()
         w = build_round_workload(n)

@@ -70,6 +70,7 @@ _FIVE_CONFIG_KEYS = (
     "aggregate_commit_cert_100v",
     "multi_tenant_blocks_per_s",
     "commit_critical_path_100v",
+    "proof_serving_100v",
     bench.headline_metric(True),
 )
 
@@ -316,6 +317,59 @@ def test_driver_conditions_config11_critical_path_evidence(driver_run):
     assert line["early_exit_lanes_skipped"] > 0
     assert line["oracle_exact"] is True
     assert line["heights"] > 0
+
+
+def test_driver_conditions_config12_proof_serving_evidence(driver_run):
+    """Config #12's evidence schema (ISSUE 10): a MEASURED proof-serving
+    line carrying the acceptance fields — warm-cache proofs/s >= 5x cold,
+    coalesced multi-client verification >= 1.5x per-client-sequential on
+    the same schedule, oracle-gated lane verdicts, and the QoS bound (a
+    live consensus chain missing ZERO heights under the read-tier proof
+    flood) — plus the cache-hit / coalesce attribution fields the
+    regression gates read."""
+    _, by_metric, _ = driver_run
+    line = by_metric["proof_serving_100v"]
+    assert line["unit"] == "proofs/s"
+    assert line["value"] > 0
+    for field in (
+        "cold_proofs_per_s",
+        "warm_proofs_per_s",
+        "warm_over_cold",
+        "coalesced_proofs_per_s",
+        "per_client_proofs_per_s",
+        "coalesce_speedup",
+        "cache_hit_rate",
+        "sig_cache_hit_rate",
+        "sched_dispatches",
+        "lanes_per_proof",
+    ):
+        assert field in line and line[field] is not None, (field, line)
+    # the two acceptance ratios, as measured under driver conditions
+    assert line["warm_over_cold"] >= 5.0, line
+    assert line["coalesce_speedup"] >= 1.5, line
+    assert line["vs_baseline"] == line["coalesce_speedup"]
+    assert line["value"] == line["coalesced_proofs_per_s"]
+    assert line["clients"] >= 4
+    # the QoS hard bound: the concurrent consensus chain missed nothing
+    qos = line["qos"]
+    assert qos["missed_heights"] == 0
+    assert qos["chain_heights"] > 0 and qos["chain_nodes"] >= 4
+    assert qos["flood_proofs"] > 0  # and the read tier still progressed
+    assert line["oracle_exact"] is True
+
+
+def test_serve_only_flag_scopes_evidence_contract():
+    """`bench.py --serve-only` (the make serve-bench entry) runs ONLY
+    config #12 and scopes the rc=0 evidence contract to it — static
+    check on _run, like the --mesh-only / --tenant-only / --latency-only
+    pins."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "serve_only" in src
+    assert "config12_proof_serving" in src
 
 
 def test_latency_only_flag_scopes_evidence_contract():

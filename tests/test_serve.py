@@ -1,0 +1,780 @@
+"""Light-client proof serving (ISSUE 10): build, cache, coalesce, verify.
+
+Pins the serve-plane acceptance surface:
+
+* honest proofs verify; per-lane signature verdicts are bit-identical to
+  the sequential :class:`HostBatchVerifier` oracle (corrupt lanes
+  included) and the accept/reject decision follows exact voting-power
+  quorum over the client's diff-walked set;
+* rotation-aware verification: a proof spliced across a majority
+  validator-set rotation with the stale set is REJECTED, as is a
+  truncated diff chain; honest rotation proofs verify;
+* adversarial proofs: certificate relabeled to a different header,
+  quorum-power-short bitmap, seal list smuggled alongside a certificate
+  (the PR 7 sync posture at the serve layer), tampered seals, structural
+  splices — all rejected, honest proofs unaffected;
+* the canonical-range cache: overlapping requests share chunks, the
+  cold stampede builds once, the tail is never cached, LRU stays
+  bounded;
+* coalescing: concurrent client verifies share the sig-verdict cache
+  and (through the scheduler read tier) shared dispatches;
+* read-tier QoS: consensus requests are selected ahead of an OLDER read
+  backlog, and a live 4-validator chain finalizes every height while a
+  proof flood hammers the same scheduler (the hard QoS bound).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.chain import ChainRunner
+from go_ibft_tpu.chain.wal import FinalizedBlock
+from go_ibft_tpu.core import IBFT, BatchingIngress
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import ecdsa as ec
+from go_ibft_tpu.crypto.backend import (
+    ECDSABackend,
+    encode_signature,
+    proposal_hash_of,
+)
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.messages.wire import Proposal
+from go_ibft_tpu.sched import TenantScheduler
+from go_ibft_tpu.serve import (
+    FinalityProof,
+    ProofBuilder,
+    ProofCache,
+    ProofEntry,
+    ProofError,
+    ProofServer,
+    ProofVerifier,
+    SetDiff,
+    SigVerdictCache,
+    any_signer_source,
+    walk_sets,
+)
+from go_ibft_tpu.verify import HostBatchVerifier
+
+from harness import NullLogger
+
+# -- fixtures ----------------------------------------------------------------
+
+_KEYS = [PrivateKey.from_seed(b"serve-%d" % i) for i in range(4)]
+_ROT = [PrivateKey.from_seed(b"serve-rot-%d" % i) for i in range(4)]
+
+
+def _static_validators(_h):
+    return {k.address: 1 for k in _KEYS}
+
+
+def _make_chain(heights, keys_for_height, corrupt=()):
+    """FinalizedBlocks with real ECDSA seals; ``corrupt`` is a set of
+    (height, signer_index) whose seal gets a flipped byte."""
+    blocks = []
+    for h in range(1, heights + 1):
+        proposal = Proposal(raw_proposal=b"serve block %d" % h, round=0)
+        phash = proposal_hash_of(proposal)
+        seals = []
+        for i, key in enumerate(keys_for_height(h)):
+            sig = encode_signature(*ec.sign(key, phash))
+            if (h, i) in corrupt:
+                sig = sig[:5] + bytes([sig[5] ^ 0xFF]) + sig[6:]
+            seals.append(CommittedSeal(signer=key.address, signature=sig))
+        blocks.append(FinalizedBlock(h, proposal, seals))
+    return blocks
+
+
+def _tampered(blocks, corrupt):
+    """Deep-enough copies of honest blocks with flipped seal bytes at the
+    given (height, signer_index) sites — corruption without re-signing
+    (pure-Python signing is ~90 ms/seal; the honest chains are module-
+    scoped and must never be mutated)."""
+    out = []
+    for block in blocks:
+        seals = []
+        for i, seal in enumerate(block.seals):
+            sig = seal.signature
+            if (block.height, i) in corrupt:
+                sig = sig[:5] + bytes([sig[5] ^ 0xFF]) + sig[6:]
+            seals.append(CommittedSeal(signer=seal.signer, signature=sig))
+        out.append(FinalizedBlock(block.height, block.proposal, seals))
+    return out
+
+
+class _ListSource:
+    """Static SyncSource over a prebuilt chain, counting range fetches."""
+
+    def __init__(self, blocks):
+        self.blocks = blocks
+        self.calls = 0
+
+    def latest_height(self):
+        return self.blocks[-1].height if self.blocks else 0
+
+    def get_blocks(self, start, end):
+        self.calls += 1
+        return [b for b in self.blocks if start <= b.height <= end]
+
+
+class _CountingLaneVerifier:
+    """HostBatchVerifier wrapper recording every fresh drain's lanes and
+    masks (the oracle-parity and dedup evidence)."""
+
+    def __init__(self):
+        self._inner = HostBatchVerifier(any_signer_source)
+        self.drains = []
+
+    def verify_seal_lanes(self, lanes, height):
+        mask = self._inner.verify_seal_lanes(lanes, height)
+        self.drains.append((list(lanes), np.asarray(mask, dtype=bool)))
+        return mask
+
+    @property
+    def lanes_seen(self):
+        return sum(len(lanes) for lanes, _ in self.drains)
+
+
+@pytest.fixture(scope="module")
+def static_blocks():
+    return _make_chain(8, lambda _h: _KEYS)
+
+
+@pytest.fixture()
+def static_chain(static_blocks):
+    # fresh counting source per test over the shared (immutable) chain
+    return static_blocks, _ListSource(static_blocks)
+
+
+# rotation at height 5: a MAJORITY of the set turns over (2 of 4), so the
+# stale pre-rotation set cannot reach quorum from the survivors alone.
+_ROT_H = 5
+
+
+def _rotating_keys(h):
+    return _KEYS if h < _ROT_H else [_KEYS[0], _KEYS[1], _ROT[0], _ROT[1]]
+
+
+def _rotating_validators(h):
+    return {k.address: 1 for k in _rotating_keys(h)}
+
+
+@pytest.fixture(scope="module")
+def rotating_blocks():
+    return _make_chain(8, _rotating_keys)
+
+
+@pytest.fixture()
+def rotating_chain(rotating_blocks):
+    return rotating_blocks, _ListSource(rotating_blocks)
+
+
+_SECOND_ROT = 7
+
+
+def _two_rotation_keys(h):
+    if h >= _SECOND_ROT:
+        return [_ROT[0], _ROT[1], _ROT[2], _ROT[3]]
+    return _rotating_keys(h)
+
+
+@pytest.fixture(scope="module")
+def two_rotation_blocks():
+    return _make_chain(8, _two_rotation_keys)
+
+
+# -- build + structure -------------------------------------------------------
+
+
+def test_build_shape_and_wire_roundtrip(static_chain):
+    blocks, source = static_chain
+    builder = ProofBuilder(source, _static_validators)
+    proof = builder.build(2, 7)
+    assert [e.height for e in proof.entries] == [3, 4, 5, 6, 7]
+    assert proof.diffs == []  # static set: no rotations
+    assert proof.checkpoint_height == 2 and proof.target == 7
+    restored = FinalityProof.from_wire(proof.to_wire())
+    assert [e.height for e in restored.entries] == [3, 4, 5, 6, 7]
+    assert restored.entries[0].proposal.raw_proposal == b"serve block 3"
+    assert restored.entries[0].seals == proof.entries[0].seals
+
+
+def test_malformed_wire_records_raise_proof_error(static_chain):
+    """Untrusted wire bytes must surface as the documented ProofError
+    contract — never a bare KeyError/ValueError escaping a client's
+    `except ProofError` handler."""
+    blocks, source = static_chain
+    wire = ProofBuilder(source, _static_validators).build(0, 4).to_wire()
+    with pytest.raises(ProofError):
+        FinalityProof.from_wire({})  # no version at all
+    with pytest.raises(ProofError):
+        FinalityProof.from_wire("not a record")
+    missing = dict(wire)
+    del missing["checkpoint"]
+    with pytest.raises(ProofError):
+        FinalityProof.from_wire(missing)
+    bad_hex = dict(wire)
+    bad_hex["entries"] = [dict(wire["entries"][0], proposal="zz-not-hex")]
+    with pytest.raises(ProofError):
+        FinalityProof.from_wire(bad_hex)
+    bad_height = dict(wire)
+    bad_height["diffs"] = [{"height": "NaNity", "added": {}, "removed": []}]
+    with pytest.raises(ProofError):
+        FinalityProof.from_wire(bad_height)
+
+
+def test_build_rejects_unservable_range(static_chain):
+    _blocks, source = static_chain
+    builder = ProofBuilder(source, _static_validators)
+    with pytest.raises(ProofError):
+        builder.build(7, 12)  # past the chain head
+    with pytest.raises(ProofError):
+        builder.build_range(0, 3)  # heights are 1-based
+
+
+def test_walk_sets_structural_rejections(static_chain):
+    blocks, source = static_chain
+    proof = ProofBuilder(source, _static_validators).build(0, 4)
+    trusted = _static_validators(1)
+    # non-contiguous entries
+    holed = FinalityProof(0, [proof.entries[0], proof.entries[2]], [])
+    with pytest.raises(ProofError):
+        walk_sets(trusted, holed)
+    # first entry does not extend the checkpoint
+    with pytest.raises(ProofError):
+        walk_sets(trusted, FinalityProof(1, list(proof.entries), []))
+    # a diff on the anchor height would substitute the trusted set
+    bad = FinalityProof(
+        0, list(proof.entries), [SetDiff(height=1, added={b"x" * 20: 1})]
+    )
+    with pytest.raises(ProofError):
+        walk_sets(trusted, bad)
+    # duplicate / unordered diffs
+    d = SetDiff(height=3, added={b"x" * 20: 1})
+    with pytest.raises(ProofError):
+        walk_sets(trusted, FinalityProof(0, list(proof.entries), [d, d]))
+    # a diff that empties the set
+    wipe = SetDiff(height=3, removed=tuple(trusted))
+    with pytest.raises(ProofError):
+        walk_sets(trusted, FinalityProof(0, list(proof.entries), [wipe]))
+
+
+def test_non_positive_powers_rejected(static_chain):
+    """A served diff carrying negative or zero powers must be rejected:
+    a non-positive total would make calculate_quorum vacuous (quorum
+    <= 0 is satisfiable by ZERO seals), letting a malicious server
+    fabricate sealless 'finalized' heights.  Pinned end-to-end: sealless
+    forged entries behind a power-poisoning diff never verify."""
+    blocks, source = static_chain
+    proof = ProofBuilder(source, _static_validators).build(0, 4)
+    trusted = _static_validators(1)
+    # negative power swamps the total
+    poison = SetDiff(height=2, added={_KEYS[0].address: -100})
+    with pytest.raises(ProofError, match="non-positive"):
+        walk_sets(trusted, FinalityProof(0, list(proof.entries), [poison]))
+    # zero power
+    zero = SetDiff(height=2, added={b"z" * 20: 0})
+    with pytest.raises(ProofError, match="non-positive"):
+        walk_sets(trusted, FinalityProof(0, list(proof.entries), [zero]))
+    # a poisoned trusted anchor is refused too
+    with pytest.raises(ProofError, match="non-positive"):
+        walk_sets({_KEYS[0].address: -1}, proof)
+    # the full exploit shape: poisoning diff + forged sealless entries
+    forged = FinalityProof(
+        0,
+        [proof.entries[0]]
+        + [
+            ProofEntry(e.height, Proposal(b"forged %d" % e.height, 0), [])
+            for e in proof.entries[1:]
+        ],
+        [poison],
+    )
+    with pytest.raises(ProofError):
+        ProofVerifier().verify(forged, trusted)
+
+
+# -- verification vs the sequential oracle -----------------------------------
+
+
+def test_honest_proof_verifies_and_masks_match_oracle(static_blocks):
+    corrupt = {(h, 3) for h in range(1, 5)}  # one bad seal per height
+    blocks = _tampered(static_blocks[:4], corrupt)
+    source = _ListSource(blocks)
+    counting = _CountingLaneVerifier()
+    verifier = ProofVerifier(lane_verifier=counting)
+    proof = ProofBuilder(source, _static_validators).build(0, 4)
+    report = verifier.verify(proof, _static_validators(1))
+    assert report["heights"] == 4 and report["lanes"] == 16
+    # every fresh lane's signature verdict is bit-identical to the
+    # sequential oracle over the REAL validator set
+    oracle = HostBatchVerifier(_static_validators)
+    for lanes, mask in counting.drains:
+        expected = oracle.verify_seal_lanes(lanes, 1)
+        assert (mask == np.asarray(expected, dtype=bool)).all()
+    # the corrupt lane really was rejected (3-of-4 quorum still holds)
+    assert not counting.drains[0][1].all()
+
+
+def test_quorum_short_proof_rejected(static_blocks):
+    corrupt = {(2, 2), (2, 3)}  # height 2 drops to 2 valid of 4 (< quorum 3)
+    blocks = _tampered(static_blocks[:3], corrupt)
+    verifier = ProofVerifier()
+    proof = ProofBuilder(_ListSource(blocks), _static_validators).build(0, 3)
+    with pytest.raises(ProofError, match="height 2"):
+        verifier.verify(proof, _static_validators(1))
+
+
+def test_duplicate_seal_does_not_double_power(static_blocks):
+    blocks = _tampered(static_blocks[:2], {(2, 2), (2, 3)})
+    # pad height 2 with duplicates of one valid signer: power must still
+    # count distinct signers only
+    blocks[1].seals.extend([blocks[1].seals[0]] * 4)
+    verifier = ProofVerifier()
+    proof = ProofBuilder(_ListSource(blocks), _static_validators).build(0, 2)
+    with pytest.raises(ProofError, match="height 2"):
+        verifier.verify(proof, _static_validators(1))
+
+
+# -- rotation-aware proofs (satellite) ---------------------------------------
+
+
+def test_rotation_proof_carries_diff_and_verifies(rotating_chain):
+    blocks, source = rotating_chain
+    builder = ProofBuilder(source, _rotating_validators)
+    proof = builder.build(0, 8)
+    assert [d.height for d in proof.diffs] == [_ROT_H]
+    diff = proof.diffs[0]
+    assert set(diff.removed) == {_KEYS[2].address, _KEYS[3].address}
+    assert set(diff.added) == {_ROT[0].address, _ROT[1].address}
+    report = ProofVerifier().verify(proof, _rotating_validators(1))
+    assert report["heights"] == 8
+
+
+def test_stale_set_splice_rejected(rotating_chain):
+    """A proof spliced across the rotation boundary with the stale set
+    (the diff chain stripped) must fail quorum at the first post-rotation
+    height: the surviving pre-rotation validators are a minority."""
+    blocks, source = rotating_chain
+    proof = ProofBuilder(source, _rotating_validators).build(0, 8)
+    stripped = FinalityProof(0, list(proof.entries), diffs=[])
+    with pytest.raises(ProofError, match=f"height {_ROT_H}"):
+        ProofVerifier().verify(stripped, _rotating_validators(1))
+
+
+def test_truncated_diff_chain_rejected(two_rotation_blocks):
+    """Two rotations; dropping the SECOND diff leaves heights past it
+    verifying under the middle set — rejected at the first bad hop."""
+    builder = ProofBuilder(
+        _ListSource(two_rotation_blocks),
+        lambda h: {k.address: 1 for k in _two_rotation_keys(h)},
+    )
+    proof = builder.build(0, 8)
+    assert [d.height for d in proof.diffs] == [_ROT_H, _SECOND_ROT]
+    verifier = ProofVerifier()  # shared sig cache: the re-verify is free
+    verifier.verify(proof, {k.address: 1 for k in _KEYS})  # honest ok
+    truncated = FinalityProof(0, list(proof.entries), [proof.diffs[0]])
+    with pytest.raises(ProofError, match=f"height {_SECOND_ROT}"):
+        verifier.verify(truncated, {k.address: 1 for k in _KEYS})
+
+
+def test_checkpoint_inside_rotated_regime(rotating_chain):
+    """A client whose checkpoint is already past the rotation anchors on
+    the post-rotation set and needs no diff."""
+    blocks, source = rotating_chain
+    proof = ProofBuilder(source, _rotating_validators).build(_ROT_H, 8)
+    assert proof.diffs == []
+    ProofVerifier().verify(proof, _rotating_validators(_ROT_H + 1))
+
+
+# -- adversarial certificate proofs (satellite) ------------------------------
+
+
+@pytest.fixture(scope="module")
+def bls_committee():
+    from go_ibft_tpu.crypto import bls as hbls
+    from go_ibft_tpu.crypto.quorum_cert import BLSCertifier
+    from go_ibft_tpu.verify.bls import encode_seal
+
+    blk = [hbls.BLSPrivateKey.from_seed(b"serve-bls-%d" % i) for i in range(4)]
+    powers = {k.address: 1 for k in _KEYS}
+    keys = {e.address: b.pubkey for e, b in zip(_KEYS, blk)}
+    blocks = _make_chain(2, lambda _h: _KEYS)
+    certifier = BLSCertifier(lambda _h: powers, lambda _h: keys)
+    # height 2 finalizes under an aggregate certificate instead of seals
+    phash = proposal_hash_of(blocks[1].proposal)
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(phash)))
+        for e, b in zip(_KEYS[:3], blk[:3])
+    ]
+    cert = certifier.build(2, 0, phash, seals)
+    assert cert is not None
+    blocks[1] = FinalizedBlock(2, blocks[1].proposal, [], cert=cert)
+    return blocks, (lambda _h: powers), (lambda _h: keys), cert
+
+
+def test_cert_proof_verifies_with_one_pairing(bls_committee):
+    blocks, validators, keys, _cert = bls_committee
+    proof = ProofBuilder(_ListSource(blocks), validators).build(0, 2)
+    verifier = ProofVerifier(bls_keys_for_height=keys)
+    report = verifier.verify(proof, validators(1))
+    assert report["pairings"] == 1
+
+
+def test_cert_without_key_source_rejected_not_trusted(bls_committee):
+    blocks, validators, _keys, _cert = bls_committee
+    proof = ProofBuilder(_ListSource(blocks), validators).build(0, 2)
+    with pytest.raises(ProofError, match="no BLS key source"):
+        ProofVerifier().verify(proof, validators(1))
+
+
+def test_cert_relabeled_to_other_header_rejected(bls_committee):
+    """A genuine certificate attached to a DIFFERENT header must fail the
+    hash binding before any pairing is spent."""
+    blocks, validators, keys, cert = bls_committee
+    other = Proposal(raw_proposal=b"forged block 2", round=0)
+    forged = [blocks[0], FinalizedBlock(2, other, [], cert=cert)]
+    proof = ProofBuilder(_ListSource(forged), validators).build(0, 2)
+    verifier = ProofVerifier(bls_keys_for_height=keys)
+    with pytest.raises(ProofError, match="does not bind"):
+        verifier.verify(proof, validators(1))
+    assert verifier.pairings == 0
+
+
+def test_quorum_power_short_bitmap_rejected(bls_committee):
+    """Clearing a bitmap bit below quorum power fails the exact-int power
+    check (no pairing spent)."""
+    from go_ibft_tpu.crypto.quorum_cert import AggregateQuorumCertificate
+
+    blocks, validators, keys, cert = bls_committee
+    short = AggregateQuorumCertificate(
+        height=cert.height,
+        round=cert.round,
+        proposal_hash=cert.proposal_hash,
+        agg_seal=cert.agg_seal,
+        # keep only the lowest set bit: 1 signer of 4 < quorum 3
+        bitmap=AggregateQuorumCertificate.bitmap_of(
+            cert.signer_indices()[:1], 4
+        ),
+    )
+    forged = [blocks[0], FinalizedBlock(2, blocks[1].proposal, [], cert=short)]
+    proof = ProofBuilder(_ListSource(forged), validators).build(0, 2)
+    verifier = ProofVerifier(bls_keys_for_height=keys)
+    with pytest.raises(ProofError, match="failed verification"):
+        verifier.verify(proof, validators(1))
+    assert verifier.pairings == 0
+
+
+def test_seal_list_smuggled_beside_cert_rejected(bls_committee):
+    """The PR 7 sync posture at the serve layer: an entry carrying BOTH a
+    certificate and a seal list is rejected before any verification."""
+    blocks, validators, keys, cert = bls_committee
+    smuggled = [
+        blocks[0],
+        FinalizedBlock(
+            2,
+            blocks[1].proposal,
+            list(blocks[0].seals),  # unverified seals riding along
+            cert=cert,
+        ),
+    ]
+    proof = ProofBuilder(_ListSource(smuggled), validators).build(0, 2)
+    verifier = ProofVerifier(bls_keys_for_height=keys)
+    with pytest.raises(ProofError, match="evidence mix"):
+        verifier.verify(proof, validators(1))
+    assert verifier.pairings == 0 and verifier.lanes_verified == 0
+
+
+# -- cache + server ----------------------------------------------------------
+
+
+def test_overlapping_requests_share_canonical_chunks(static_chain):
+    blocks, source = static_chain
+    server = ProofServer(
+        ProofBuilder(source, _static_validators),
+        ProofCache(chunk_heights=4),
+    )
+    p1 = server.get_proof(0, 4)  # chunk [1..4]
+    calls_after_first = source.calls
+    p2 = server.get_proof(1, 4)  # same chunk, different checkpoint
+    assert source.calls == calls_after_first  # served entirely from cache
+    assert [e.height for e in p1.entries] == [1, 2, 3, 4]
+    assert [e.height for e in p2.entries] == [2, 3, 4]
+    assert p2.entries[0] is p1.entries[1]  # literally shared entries
+    assert server.cache.stats()["hits"] >= 1
+    ProofVerifier().verify(p2, _static_validators(2))
+
+
+def test_tail_segment_is_never_cached(static_chain):
+    blocks, source = static_chain
+    server = ProofServer(
+        ProofBuilder(source, _static_validators),
+        ProofCache(chunk_heights=16),  # whole chain inside one open chunk
+    )
+    server.get_proof(0)
+    server.get_proof(0)
+    assert len(server.cache) == 0  # still-growing window: rebuilt per request
+    assert server.chunks_built == 0
+
+
+def test_cold_stampede_builds_each_chunk_once(static_chain):
+    blocks, source = static_chain
+    server = ProofServer(
+        ProofBuilder(source, _static_validators),
+        ProofCache(chunk_heights=4),
+    )
+    results, errors = [], []
+
+    def client():
+        try:
+            proof = server.get_proof(0, 8)  # chunks [1..4] + [5..8], no tail
+            results.append(server.verify_proof(proof, _static_validators(1)))
+        except BaseException as err:  # noqa: BLE001 - surfaced below
+            errors.append(err)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    assert not errors, errors
+    assert len(results) == 8
+    assert server.chunks_built == 2  # one build per canonical chunk
+    assert source.calls == 2
+
+
+def test_cache_lru_stays_bounded(static_chain):
+    blocks, source = static_chain
+    server = ProofServer(
+        ProofBuilder(source, _static_validators),
+        ProofCache(chunk_heights=2, max_chunks=2),
+    )
+    server.get_proof(0, 8)  # 4 canonical chunks through a 2-chunk cache
+    stats = server.cache.stats()
+    assert stats["chunks"] <= 2
+    assert stats["evictions"] >= 2
+
+
+def test_server_clamps_and_rejects_empty_ranges(static_chain):
+    blocks, source = static_chain
+    server = ProofServer(ProofBuilder(source, _static_validators))
+    proof = server.get_proof(6, 99)  # clamped to the chain head
+    assert proof.target == 8
+    with pytest.raises(ProofError):
+        server.get_proof(8)  # nothing past the head
+    with pytest.raises(ProofError):
+        server.get_proof(-1)
+
+
+def test_self_check_refuses_to_serve_corrupt_chain(static_blocks):
+    """A chain whose stored evidence cannot re-verify (two tampered seals
+    drop height 2 below quorum) must fail at the SERVER, not at a
+    client."""
+    blocks = _tampered(static_blocks[:4], {(2, 2), (2, 3)})
+    server = ProofServer(
+        ProofBuilder(_ListSource(blocks), _static_validators),
+        ProofCache(chunk_heights=4),
+    )
+    with pytest.raises(ProofError, match="self-check"):
+        server.get_proof(0, 4)
+    assert len(server.cache) == 0  # a failed chunk is never cached
+
+
+def test_sig_verdict_cache_dedupes_across_clients(static_chain):
+    blocks, source = static_chain
+    counting = _CountingLaneVerifier()
+    shared = SigVerdictCache()
+    v1 = ProofVerifier(lane_verifier=counting, sig_cache=shared)
+    v2 = ProofVerifier(lane_verifier=counting, sig_cache=shared)
+    proof = ProofBuilder(source, _static_validators).build(0, 4)
+    v1.verify(proof, _static_validators(1))
+    lanes_after_first = counting.lanes_seen
+    assert lanes_after_first == 16
+    v2.verify(proof, _static_validators(1))  # fully served from the cache
+    assert counting.lanes_seen == lanes_after_first
+    assert shared.stats()["hits"] == 16
+
+
+def test_sig_verdict_cache_bounded():
+    cache = SigVerdictCache(cap=8)
+    keys = [(b"h%031d" % i, b"s" * 20, b"g" * 65) for i in range(32)]
+    cache.store_batch(keys, [True] * len(keys))
+    assert cache.stats()["entries"] == 8
+
+
+# -- scheduler coalescing + read-tier QoS ------------------------------------
+
+
+def test_concurrent_verifies_coalesce_through_scheduler(static_chain):
+    blocks, source = static_chain
+    sched = TenantScheduler(window_s=0.002, route="host")
+    with sched:
+        server = ProofServer(
+            ProofBuilder(source, _static_validators),
+            ProofCache(chunk_heights=4),
+            scheduler=sched,
+            tenant_id="serve-server",
+        )
+        oracle = HostBatchVerifier(_static_validators)
+        results, errors = [], []
+
+        def client():
+            try:
+                proof = server.get_proof(0, 8)
+                results.append(
+                    server.verify_proof(proof, _static_validators(1))
+                )
+            except BaseException as err:  # noqa: BLE001
+                errors.append(err)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not errors, errors
+        assert len(results) == 8
+        stats = sched.stats()
+        server.close()
+    # the serve tenant registered on the read tier; the pre-serve
+    # self-check drove exactly one fresh drain set through it (2 chunks x
+    # 16 lanes) and every CLIENT verify was then served whole from the
+    # shared sig-verdict cache — the serve plane's coalescing story: 8
+    # clients over 64 lanes cost 32 fresh lane verifies, total.
+    row = stats["tenants"]["serve-server"]
+    assert row["priority"] == "read"
+    assert row["lanes"] == 32
+    assert stats["flush_faults"] == 0
+    assert stats["dispatches"] >= 1
+    # verdict honesty: the coalesced plane accepted exactly what the
+    # sequential oracle accepts for the same chain
+    lanes = [
+        (proposal_hash_of(b.proposal), seal)
+        for b in blocks
+        for seal in b.seals
+    ]
+    assert np.asarray(oracle.verify_seal_lanes(lanes, 1), dtype=bool).all()
+
+
+def test_read_priority_never_selected_ahead_of_consensus():
+    """White-box selection pin: with an OLDER read-tier backlog queued,
+    the next flush still ships the consensus request first and read
+    lanes only fill the remaining capacity."""
+    from go_ibft_tpu.sched.scheduler import _Request
+
+    sched = TenantScheduler(window_s=0.001, route="host")
+    sched.register("chain", _static_validators, priority="consensus")
+    sched.register("serve", any_signer_source, priority="read")
+    chain_t = sched._tenants["chain"]
+    serve_t = sched._tenants["serve"]
+
+    def enqueue(tenant, lanes, age):
+        req = _Request(
+            tenant, "seals", [(b"h" * 32, None)] * lanes, 1,
+            np.zeros(lanes, dtype=bool), list(range(lanes)),
+        )
+        req.submitted_at = age
+        tenant.queue.append(req)
+        tenant.queued_lanes += req.lanes
+        sched._pending_reqs += 1
+        sched._pending_lanes += req.lanes
+        return req
+
+    old_read = enqueue(serve_t, 64, age=1.0)  # much older
+    young_consensus = enqueue(chain_t, 8, age=2.0)
+    batch = sched._select_locked()
+    assert batch[0] is young_consensus  # consensus first, despite age
+    assert old_read in batch  # read still drains in the spare capacity
+
+
+def test_register_rejects_unknown_priority():
+    sched = TenantScheduler()
+    with pytest.raises(ValueError, match="priority"):
+        sched.register("x", _static_validators, priority="bulk")
+
+
+def test_proof_flood_cannot_starve_live_chain(static_blocks):
+    """The QoS hard bound (ISSUE 10 satellite): a proof-verify flood on
+    the read tier runs concurrently with a live 4-validator chain on the
+    consensus tier of the SAME scheduler — the chain finalizes every
+    height (misses zero), and the flood itself makes progress."""
+    heights = 2
+    sched = TenantScheduler(window_s=0.001, route="host")
+    flood_blocks = static_blocks[:6]
+    flood_stop = threading.Event()
+    flood_proofs = []
+    flood_errors = []
+
+    def flood():
+        source = _ListSource(flood_blocks)
+        server = ProofServer(
+            ProofBuilder(source, _static_validators),
+            ProofCache(chunk_heights=2),
+            scheduler=sched,
+        )
+        try:
+            while not flood_stop.is_set():
+                # fresh sig cache per iteration: every pass drives REAL
+                # lanes through the read tier, not cache hits
+                server.verifier.sig_cache.clear()
+                proof = server.get_proof(0, 6)
+                flood_proofs.append(
+                    server.verify_proof(proof, _static_validators(1))
+                )
+        except BaseException as err:  # noqa: BLE001 - surfaced below
+            flood_errors.append(err)
+        finally:
+            server.close()
+
+    async def drive_chain():
+        keys = [PrivateKey.from_seed(b"qos-%d" % i) for i in range(4)]
+        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        nodes, runners = [], []
+
+        class _T:
+            def multicast(self, message):
+                for ingress in nodes:
+                    ingress.submit(message)
+
+        for i, key in enumerate(keys):
+            handle = sched.register(
+                f"qos-chain/n{i}", src, chain_id="qos-chain"
+            )
+            core = IBFT(
+                NullLogger(), ECDSABackend(key, src), _T(),
+                batch_verifier=handle,
+            )
+            core.set_base_round_timeout(30.0)
+            nodes.append(BatchingIngress(core.add_messages))
+            runners.append(ChainRunner(core, overlap=False))
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(
+                    *(r.run(until_height=heights) for r in runners)
+                ),
+                120.0,
+            )
+        finally:
+            for runner, ingress in zip(runners, nodes):
+                ingress.close()
+                runner.engine.messages.close()
+        return [r.latest_height() for r in runners]
+
+    with sched:
+        flood_thread = threading.Thread(target=flood, daemon=True)
+        flood_thread.start()
+        try:
+            finalized = asyncio.run(drive_chain())
+        finally:
+            flood_stop.set()
+            flood_thread.join(60.0)
+    assert not flood_thread.is_alive()
+    assert not flood_errors, flood_errors
+    assert finalized == [heights] * 4, (
+        f"chain missed heights under the proof flood: {finalized}"
+    )
+    assert len(flood_proofs) > 0, "read tier made no progress at all"
+    rows = sched.stats()["tenants"]
+    assert all(
+        rows[f"qos-chain/n{i}"]["priority"] == "consensus" for i in range(4)
+    )

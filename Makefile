@@ -3,7 +3,7 @@
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
-	serve-bench
+	serve-bench timeline-smoke slo-gates
 
 test:
 	python -m pytest tests/ -q
@@ -91,6 +91,21 @@ ab-keccak:
 # best prior BENCH_r*.json on the same backend (go_ibft_tpu/obs/gates.py)
 obs-report:
 	python scripts/obs_report.py
+
+# Telemetry-plane smoke (ISSUE 11, fast-tier CI): a 4-node loopback chain
+# with /metrics,/healthz,/statusz mounted is scraped WHILE finalizing,
+# its flight-recorder trace is reconstructed into the per-height
+# consensus critical path, and the run's SLO records are graded.
+timeline-smoke:
+	rm -f slo.jsonl
+	JAX_PLATFORMS=cpu GO_IBFT_SLO_PATH=slo.jsonl \
+	python scripts/timeline_smoke.py
+
+# SLO gates over soak-emitted records (missed_heights, finalize p99,
+# shed/quarantine counts): liveness regressions fail CI exactly like
+# perf regressions (go_ibft_tpu/obs/gates.py::gate_slo_records)
+slo-gates:
+	python scripts/slo_gates.py
 
 # Pre-warm the expensive kernel compiles into the persistent XLA cache
 # (CI slow tier runs this before pytest so no compile hits a test timeout)

@@ -1015,7 +1015,29 @@ def config7_chain() -> None:
             "synced_heights": sum(r.synced_heights for r in runners),
         }
 
+    # Optional telemetry-plane artifact: GO_IBFT_CHAIN_TRACE=<path> records
+    # the overlap-ON variant's flight-recorder spans (net.send/net.recv
+    # trace propagation included) and exports a trace that
+    # scripts/consensus_timeline.py reconstructs into the per-height
+    # critical path.  Strictly opt-in so the measured numbers are
+    # untouched on default runs; when bench-wide --trace already enabled
+    # the recorder, this just adds the extra per-config export.
+    chain_trace = os.environ.get("GO_IBFT_CHAIN_TRACE")
+    trace_was_enabled = False
+    if chain_trace:
+        from go_ibft_tpu.obs import trace as obs_trace
+
+        trace_was_enabled = obs_trace.enabled()
+        if not trace_was_enabled:
+            obs_trace.enable(1 << 18)
     on = asyncio.run(run_variant(True, "on"))
+    if chain_trace:
+        from go_ibft_tpu.obs import trace as obs_trace
+        from go_ibft_tpu.obs.export import write_chrome_trace
+
+        write_chrome_trace(chain_trace, node="bench-config7")
+        if not trace_was_enabled:
+            obs_trace.disable()
     off = asyncio.run(run_variant(False, "off"))
     _log(
         {
@@ -1028,6 +1050,7 @@ def config7_chain() -> None:
             "nodes": n,
             "overlap_on": on,
             "overlap_off": off,
+            "trace_path": chain_trace or None,
         }
     )
 
@@ -2691,7 +2714,12 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
     if args.trace:
-        obs_trace.enable()
+        # Sized for the full config matrix WITH per-message net.send/
+        # net.recv propagation records (ISSUE 11): the ring must not wrap
+        # during a driver run — test_driver_conditions_trace_covers_every_
+        # drain pins droppedRecords == 0, because a truncated window
+        # orphans spans at the wrap boundary.
+        obs_trace.enable(1 << 19)
     try:
         _run(args)
     finally:

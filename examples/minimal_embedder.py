@@ -18,6 +18,7 @@ Run: ``python examples/minimal_embedder.py [--device]``
 import argparse
 import asyncio
 import sys
+from typing import Optional
 
 sys.path.insert(0, ".")
 
@@ -223,6 +224,7 @@ async def main_chain(
     use_mesh: bool = False,
     use_aggregate: bool = False,
     use_speculate: bool = False,
+    telemetry_port: Optional[int] = None,
 ) -> None:
     """The continuous-node mode: one ChainRunner per validator.
 
@@ -268,6 +270,14 @@ async def main_chain(
             )
             network.register(engine.backend.id(), runner)
             runners.append(runner)
+        telemetry = None
+        if telemetry_port is not None:
+            # The telemetry plane (docs/OBSERVABILITY.md): node 0 serves
+            # /metrics (Prometheus text), /healthz (liveness; flips when
+            # the runner wedges), and /statusz (height/round, breaker,
+            # speculation + ring stats) while the chain runs.
+            telemetry = runners[0].start_telemetry(port=telemetry_port)
+            print(f"telemetry: {telemetry.url}/metrics /healthz /statusz")
         if hub is not None:
             hub.start()
         try:
@@ -275,6 +285,8 @@ async def main_chain(
                 *(r.run(until_height=heights) for r in runners)
             )
         finally:
+            if telemetry is not None:
+                telemetry.stop()
             if hub is not None:
                 await hub.stop()
             for engine in engines:
@@ -526,6 +538,16 @@ if __name__ == "__main__":
         "p99 and the coalesce ratio",
     )
     ap.add_argument(
+        "--telemetry",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="(--chain mode) mount the telemetry endpoints on node 0: "
+        "/metrics (Prometheus text), /healthz (liveness), /statusz "
+        "(operator status JSON); 0 binds an ephemeral port "
+        "(docs/OBSERVABILITY.md)",
+    )
+    ap.add_argument(
         "--serve",
         type=int,
         default=0,
@@ -541,15 +563,28 @@ if __name__ == "__main__":
     elif args.tenants:
         main_tenants(args.nodes, args.heights, args.tenants)
     else:
-        runner = main_chain if args.chain else main_async
-        asyncio.run(
-            runner(
-                args.nodes,
-                args.heights,
-                args.device,
-                args.bls,
-                args.mesh,
-                args.aggregate,
-                args.speculate,
+        if args.chain:
+            asyncio.run(
+                main_chain(
+                    args.nodes,
+                    args.heights,
+                    args.device,
+                    args.bls,
+                    args.mesh,
+                    args.aggregate,
+                    args.speculate,
+                    telemetry_port=args.telemetry,
+                )
             )
-        )
+        else:
+            asyncio.run(
+                main_async(
+                    args.nodes,
+                    args.heights,
+                    args.device,
+                    args.bls,
+                    args.mesh,
+                    args.aggregate,
+                    args.speculate,
+                )
+            )

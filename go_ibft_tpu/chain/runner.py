@@ -586,6 +586,130 @@ class ChainRunner:
             self.height = blocks[-1].height + 1
             self._restore = None  # the locked height was finalized by peers
 
+    # -- telemetry plane (live endpoints + per-node trace export) ---------
+
+    def start_telemetry(
+        self,
+        port: int = 0,
+        *,
+        host: str = "127.0.0.1",
+        wedged_after_s: Optional[float] = None,
+        extra_status: Optional[dict] = None,
+    ):
+        """Mount /metrics, /healthz, /statusz for this node.
+
+        Default-off: nothing calls this unless the embedder (or
+        ``examples/minimal_embedder.py --telemetry``) asks — benches are
+        unaffected.  Also turns the fixed-bucket latency histograms on
+        (they are what /metrics serves).  ``wedged_after_s`` is the
+        /healthz liveness bound: a runner that has not advanced a height
+        for that long while running reports unhealthy (default: 3x the
+        engine's base round timeout + 5s — a full round 0 plus round-
+        change slack).  ``extra_status`` maps status keys to zero-arg
+        callables merged into /statusz per scrape (mount scheduler or
+        proof-server stats here).  Returns the started
+        :class:`~go_ibft_tpu.obs.httpd.TelemetryServer` (``.port`` holds
+        the bound port).
+        """
+        from ..obs.httpd import TelemetryServer
+
+        metrics.enable_fixed_histograms()
+        self._telemetry_extra = dict(extra_status or {})
+        self._wedged_after_s = wedged_after_s
+        server = TelemetryServer(
+            status_fn=self.telemetry_status,
+            health_fn=self.telemetry_health,
+            host=host,
+            port=port,
+        )
+        server.start()
+        self._telemetry = server
+        return server
+
+    def stop_telemetry(self) -> None:
+        server = getattr(self, "_telemetry", None)
+        if server is not None:
+            server.stop()
+            self._telemetry = None
+
+    def telemetry_status(self) -> dict:
+        """The /statusz payload: one lock-free snapshot of the node."""
+        from ..obs import trace
+
+        engine = self.engine
+        recorder = trace.recorder()
+        verifier = engine.batch_verifier
+        breaker = getattr(verifier, "breaker", None)
+        speculator = getattr(engine, "speculator", None)
+        status = {
+            "node": self._track,
+            "running": self._running,
+            "height": engine.state.height,
+            "round": engine.state.round,
+            "state": str(getattr(engine.state.name, "name", engine.state.name)),
+            "next_height": self.height,
+            "chain_height": self.latest_height(),
+            "heights_run": self.heights_run,
+            "synced_heights": self.synced_heights,
+            "overlapped_lanes": self.overlapped_lanes,
+            "breaker_level": getattr(breaker, "level", None),
+            "speculation": (
+                speculator.stats() if speculator is not None else None
+            ),
+            "ring_dropped": recorder.dropped if recorder is not None else None,
+            "handoff_ms_mean": (
+                sum(self.handoff_ms) / len(self.handoff_ms)
+                if self.handoff_ms
+                else None
+            ),
+        }
+        for key, fn in getattr(self, "_telemetry_extra", {}).items():
+            try:
+                status[key] = fn()
+            except Exception as err:  # noqa: BLE001 - a scrape never crashes
+                status[key] = {"error": repr(err)}
+        return status
+
+    def telemetry_health(self):
+        """The /healthz verdict: (ok, payload).
+
+        Unhealthy iff the runner is live but has not started a new height
+        within the wedge bound — the restart signal a fleet orchestrator
+        polls.  A stopped runner is healthy (it is not wedged, it is
+        done); a sequence legitimately waiting out round changes stays
+        healthy until the bound, which defaults past a full round 0.
+        """
+        limit = getattr(self, "_wedged_after_s", None)
+        if limit is None:
+            limit = 3.0 * self.engine.base_round_timeout + 5.0
+        stale_s = time.monotonic() - self._height_started
+        wedged = self._running and stale_s > limit
+        return not wedged, {
+            "ok": not wedged,
+            "wedged": wedged,
+            "running": self._running,
+            "stale_s": round(stale_s, 3),
+            "limit_s": limit,
+            "height": self.height,
+            "chain_height": self.latest_height(),
+        }
+
+    def export_trace(self, path: str) -> int:
+        """Per-node flight-recorder export with node identity + clock
+        offsets stamped in (the cross-process timeline contract).
+
+        The stamped identity is the ENGINE's track (``node-<id>``), not
+        the runner's ``chain-<id>``: peers key their clock-offset
+        estimates by the trace-context ``origin``, which is the engine
+        track — the timeline tool matches ``otherData.node`` against
+        those keys to rebase this file's clock.
+        """
+        from ..obs.export import write_chrome_trace
+
+        return write_chrome_trace(
+            path, node=getattr(self.engine, "_obs_track", self._track)
+        )
+
     # -- evidence ---------------------------------------------------------
 
     def stats(self) -> dict:

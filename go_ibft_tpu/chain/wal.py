@@ -36,11 +36,17 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import PreparedCertificate, Proposal
+from ..utils import metrics
+
+# Fixed-bucket append latency (fsync included) for the /metrics endpoint;
+# recorded only while metrics.enable_fixed_histograms() is on.
+WAL_APPEND_MS_KEY = ("go-ibft", "latency", "wal_append_ms")
 
 __all__ = [
     "FinalizedBlock",
@@ -149,12 +155,21 @@ class WriteAheadLog:
 
     def _append(self, record: dict, fsync: bool) -> None:
         line = json.dumps(record, separators=(",", ":")) + "\n"
+        t0 = (
+            time.perf_counter()
+            if metrics.fixed_histograms_enabled()
+            else None
+        )
         with self._lock:
             fh = self._file()
             fh.write(line.encode())
             fh.flush()
             if fsync:
                 os.fsync(fh.fileno())
+        if t0 is not None:
+            metrics.observe_fixed(
+                WAL_APPEND_MS_KEY, (time.perf_counter() - t0) * 1e3
+            )
 
     def append_finalize(
         self,

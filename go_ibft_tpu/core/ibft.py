@@ -36,9 +36,11 @@ from ..messages.wire import (
     PreparedCertificate,
     Proposal,
     RoundChangeCertificate,
+    TraceContext,
     View,
 )
 from ..obs import trace
+from ..utils import metrics
 from ..utils.metrics import set_gauge
 from .backend import Backend, BatchVerifier
 from .state import SequenceState, StateName
@@ -47,6 +49,12 @@ from .validator_manager import Logger, ValidatorManager, senders_of
 
 # Default base round (round 0) timeout, seconds (reference core/ibft.go:49-50).
 DEFAULT_BASE_ROUND_TIMEOUT = 10.0
+
+# Fixed-bucket latency family (telemetry plane): proposal-accept ->
+# finalize, the per-height number the /metrics endpoint and the SLO soak
+# gates read.  Recorded only while ``metrics.enable_fixed_histograms()``
+# is on — the same one-predicate disabled posture as the tracer.
+ACCEPT_FINALIZE_MS_KEY = ("go-ibft", "latency", "accept_finalize_ms")
 
 _ROUND_FACTOR_BASE = 2.0
 
@@ -181,6 +189,9 @@ class IBFT:
         self._seal_verdicts: dict[int, dict[tuple, bool]] = {}
         self._seal_verdict_count = 0
         self._seal_verdict_cap = 16384
+        # accept -> finalize latency anchor (set by _accept_proposal,
+        # consumed by _insert_block into ACCEPT_FINALIZE_MS_KEY).
+        self._accept_ts: Optional[float] = None
         # Memoized is_valid_proposal_hash verdicts for the ACCEPTED proposal
         # (cleared whenever it changes): a prepare/commit drain checks the
         # carried hash once per message per wakeup, and the backend call
@@ -299,6 +310,7 @@ class IBFT:
         self._seal_verdicts.clear()
         self._seal_verdict_count = 0
         self._hash_memo.clear()
+        self._accept_ts = None
         self.finalized_certificate = None
         with self._cert_lock:
             for h in [h for h in self._pending_certs if h < height]:
@@ -636,6 +648,9 @@ class IBFT:
 
                 self._hash_memo.clear()
                 self.state.set_proposal_message(proposal_message)
+                # Non-proposer accept point: the accept -> finalize
+                # latency anchor (the proposer's is _accept_proposal).
+                self._accept_ts = time.perf_counter()
                 self._send_prepare_message(view)
                 self.log.debug("prepare message multicasted")
                 self.state.change_state(StateName.PREPARE)
@@ -1396,6 +1411,33 @@ class IBFT:
 
     # -- inbound path (reference core/ibft.go:1101-1149) --------------------
 
+    def _record_recv(self, message: IbftMessage) -> None:
+        """``net.recv`` instant for a delivered traced message.
+
+        Called from the ingress paths with tracing already known enabled.
+        Loopback dispatch hands the SAME message object to every engine,
+        so the context is never mutated here — each receiver records its
+        own instant on its own track; a socket transport that already
+        recorded at the wire boundary sets ``ctx.recorded`` and is
+        skipped.  A message re-entering ingress via the future-buffer
+        flush may record twice on one node; the timeline tool keys on
+        first arrival per (node, origin), so duplicates are harmless
+        (chaos duplication produces them anyway).
+        """
+        ctx = getattr(message, "trace_ctx", None)
+        if ctx is None or ctx.recorded:
+            return
+        trace.instant(
+            "net.recv",
+            track=self._obs_track,
+            origin=ctx.origin,
+            height=ctx.height,
+            round=ctx.round,
+            type=int(message.type),
+            span=ctx.span_id,
+            sent_us=ctx.sent_us,
+        )
+
     def add_message(self, message: Optional[IbftMessage]) -> None:
         """Feed one message into the engine (thread-safe).
 
@@ -1404,6 +1446,8 @@ class IBFT:
         """
         if message is None:
             return
+        if trace.enabled():
+            self._record_recv(message)
         if not self._is_acceptable_message(message):
             self._buffer_future(message)
             return
@@ -1421,6 +1465,9 @@ class IBFT:
         """
         if not batch:
             return
+        if trace.enabled():
+            for m in batch:
+                self._record_recv(m)
         with trace.span(
             "ingress.batch", track=self._obs_track, lanes=len(batch)
         ):
@@ -1747,6 +1794,9 @@ class IBFT:
         trace.instant(
             "proposal.accept", track=self._obs_track, round=self.state.round
         )
+        # accept -> finalize latency anchor (one clock read per proposal;
+        # the histogram itself records only when fixed histograms are on).
+        self._accept_ts = time.perf_counter()
         self._hash_memo.clear()
         self.state.set_proposal_message(proposal_message)
         self.state.change_state(StateName.PREPARE)
@@ -1768,6 +1818,12 @@ class IBFT:
             round=self.state.round,
         )
         seals = self.state.committed_seals
+        if self._accept_ts is not None:
+            metrics.observe_fixed(
+                ACCEPT_FINALIZE_MS_KEY,
+                (time.perf_counter() - self._accept_ts) * 1e3,
+            )
+            self._accept_ts = None
         self.backend.insert_proposal(proposal, seals)
         if self.on_finalize is not None:
             self.on_finalize(height, proposal, seals)
@@ -1775,11 +1831,45 @@ class IBFT:
 
     # -- outbound (reference core/ibft.go:1234-1270) ------------------------
 
-    def _send_preprepare_message(self, message: IbftMessage) -> None:
+    def _multicast(self, message: IbftMessage) -> None:
+        """Stamp + multicast: the telemetry plane's outbound seam.
+
+        When tracing is enabled every outbound message gains a
+        :class:`~go_ibft_tpu.messages.wire.TraceContext` (origin track,
+        view, monotonic send µs, fresh span id) and a ``net.send``
+        instant; receivers record the matching ``net.recv`` at ingress,
+        so N nodes' flight recorders hold causally-linked records the
+        timeline tool (:mod:`go_ibft_tpu.obs.timeline`) can merge.  The
+        context rides OUTSIDE the signed bytes — object attribute on
+        loopback, :func:`~go_ibft_tpu.messages.wire.encode_traced` frame
+        on socket transports — so signatures are unaffected.  Disabled
+        tracing keeps this a single predicate check.
+        """
+        if trace.enabled():
+            view = message.view
+            ctx = TraceContext(
+                origin=self._obs_track,
+                height=view.height if view is not None else self.state.height,
+                round=view.round if view is not None else self.state.round,
+                sent_us=time.perf_counter_ns() // 1000,
+                span_id=trace.next_span_id(),
+            )
+            message.trace_ctx = ctx
+            trace.instant(
+                "net.send",
+                track=self._obs_track,
+                height=ctx.height,
+                round=ctx.round,
+                type=int(message.type),
+                span=ctx.span_id,
+            )
         self.transport.multicast(message)
 
+    def _send_preprepare_message(self, message: IbftMessage) -> None:
+        self._multicast(message)
+
     def _send_round_change_message(self, height: int, new_round: int) -> None:
-        self.transport.multicast(
+        self._multicast(
             self.backend.build_round_change_message(
                 self.state.latest_prepared_proposal,
                 self.state.latest_pc,
@@ -1788,11 +1878,11 @@ class IBFT:
         )
 
     def _send_prepare_message(self, view: View) -> None:
-        self.transport.multicast(
+        self._multicast(
             self.backend.build_prepare_message(self.state.proposal_hash or b"", view)
         )
 
     def _send_commit_message(self, view: View) -> None:
-        self.transport.multicast(
+        self._multicast(
             self.backend.build_commit_message(self.state.proposal_hash or b"", view)
         )

@@ -38,6 +38,13 @@ class LoopbackTransport:
     (core/mock_test.go:546-550, core/helpers_test.go:227-231).  Delivery is
     synchronous and in registration order; a delivery hook lets fault tests
     drop or mutate messages per (sender, receiver).
+
+    Telemetry: loopback dispatch hands the SAME stamped message object to
+    every receiver, and each receiving engine records its own ``net.recv``
+    instant at ingress (``IBFT._record_recv``) — the loopback delivery
+    callback IS the engine ingress, so the trace context needs no wire
+    framing here and the shared process clock makes every clock offset
+    exactly zero.
     """
 
     def __init__(self) -> None:

@@ -478,3 +478,106 @@ class IbftMessage(_Decodable):
         an embedder signs and verifies.
         """
         return self.encode(include_signature=False)
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation (cross-process telemetry plane)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TraceContext(_Decodable):
+    """Compact per-message trace context carried OUTSIDE the signed bytes.
+
+    The telemetry plane stamps every outbound consensus message with the
+    sender's identity and clock so receivers can record causally-linked
+    ``net.recv`` events and estimate per-peer clock offsets
+    (``go_ibft_tpu.obs.clock``).  The context rides as a framing layer
+    AROUND the message (:func:`encode_traced`), never inside
+    ``IbftMessage`` — ``payload_no_sig`` and therefore every signature
+    stays byte-identical to the reference, traced or not.
+
+    Fields: ``origin`` is the sender's flight-recorder track (one row per
+    node), ``height``/``round`` the message's view, ``sent_us`` the
+    sender's monotonic ``perf_counter_ns() // 1000`` at multicast time
+    (meaningless across processes except as a clock-offset sample), and
+    ``span_id`` a per-process send counter linking the sender's
+    ``net.send`` instant to every receiver's ``net.recv``.
+    """
+
+    origin: str = ""
+    height: int = 0
+    round: int = 0
+    sent_us: int = 0
+    span_id: int = 0
+    # Delivery-side bookkeeping, never encoded: a transport that already
+    # recorded the net.recv for this context (GrpcTransport does, at the
+    # wire boundary) sets this so the engine ingress does not record it a
+    # second time.  Loopback dispatch leaves it False — the SAME message
+    # object reaches every receiver, and each engine records its own recv.
+    recorded: bool = False
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        _emit_bytes(out, 1, self.origin.encode("utf-8"))
+        _emit_uint(out, 2, self.height)
+        _emit_uint(out, 3, self.round)
+        _emit_uint(out, 4, self.sent_us)
+        _emit_uint(out, 5, self.span_id)
+        return bytes(out)
+
+    def _merge_field(self, fnum, wtype, buf, pos):
+        if fnum == 1 and wtype == _WIRE_LEN:
+            raw, pos = _read_bytes(buf, pos)
+            self.origin = raw.decode("utf-8", "replace")
+            return pos
+        if fnum == 2 and wtype == _WIRE_VARINT:
+            self.height, pos = _decode_varint(buf, pos)
+            return pos
+        if fnum == 3 and wtype == _WIRE_VARINT:
+            self.round, pos = _decode_varint(buf, pos)
+            return pos
+        if fnum == 4 and wtype == _WIRE_VARINT:
+            self.sent_us, pos = _decode_varint(buf, pos)
+            return pos
+        if fnum == 5 and wtype == _WIRE_VARINT:
+            self.span_id, pos = _decode_varint(buf, pos)
+            return pos
+        return None
+
+
+# Framing magic for traced payloads.  The first byte decodes as protobuf
+# tag (field 26, wire type 7) — wire type 7 does not exist, so no valid
+# ``IbftMessage`` encoding can ever start with it: a receiver can always
+# tell a traced frame from a bare message without version negotiation.
+TRACED_MAGIC = b"\xd7TCX"
+
+
+def encode_traced(message_bytes: bytes, ctx: TraceContext) -> bytes:
+    """Wrap encoded message bytes with a trace-context frame."""
+    ctx_bytes = ctx.encode()
+    return (
+        TRACED_MAGIC + _encode_varint(len(ctx_bytes)) + ctx_bytes + message_bytes
+    )
+
+
+def decode_traced(data: bytes) -> tuple[bytes, Optional[TraceContext]]:
+    """Split a payload into (message bytes, trace context or ``None``).
+
+    Bare (untraced) payloads pass through unchanged — the framing is
+    strictly additive, and a malformed trace frame from an untrusted peer
+    degrades to ``None`` context rather than an error (telemetry must
+    never affect message delivery; the message bytes themselves still go
+    through the usual decode-and-verify path).
+    """
+    if not data.startswith(TRACED_MAGIC):
+        return data, None
+    try:
+        length, pos = _decode_varint(data, len(TRACED_MAGIC))
+        end = pos + length
+        if end > len(data):
+            raise ValueError("truncated trace context")
+        ctx = TraceContext.decode(data[pos:end])
+        return data[end:], ctx
+    except ValueError:
+        return data[len(TRACED_MAGIC):], None

@@ -28,15 +28,16 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from typing import Callable, Dict, Optional, Sequence
 
 import grpc
 
 from ..core.ibft import DEFAULT_BASE_ROUND_TIMEOUT
-from ..obs import trace
+from ..obs import clock, trace
 from ..utils import metrics
 
-from ..messages.wire import IbftMessage
+from ..messages.wire import IbftMessage, decode_traced, encode_traced
 
 _SERVICE = "goibft.Transport"
 _METHOD = "Multicast"
@@ -76,7 +77,18 @@ class GrpcTransport:
         base_backoff_s: float = 0.05,
         per_attempt_timeout_s: float = 2.0,
         retry_seed: Optional[int] = None,
+        node: Optional[str] = None,
     ) -> None:
+        # Telemetry identity: the flight-recorder track inbound wire
+        # events land on.  Pass the engine's node track (``node-<id>``)
+        # for per-node timeline rows that match; without it, wire events
+        # land on a ``net-<addr>`` diagnostics track AND the context is
+        # left unmarked so the ENGINE still records the canonical
+        # ``net.recv`` on its own track — the timeline tool only counts
+        # recvs on consensus tracks, so the default never poisons the
+        # quorum reconstruction.
+        self._node_explicit = node is not None
+        self.node = node or f"net-{listen_addr}"
         self._listen_addr = listen_addr
         self._peers = dict(peers)
         self._deliver = deliver
@@ -100,12 +112,41 @@ class GrpcTransport:
         server = grpc.aio.server()
 
         async def _handle(request: bytes, context) -> bytes:
+            raw, ctx = decode_traced(request)
             try:
-                message = IbftMessage.decode(request)
+                message = IbftMessage.decode(raw)
             except Exception as err:  # noqa: BLE001 - malformed peer input
                 if self._log:
                     self._log.error("grpc transport: undecodable message", err)
                 return b""
+            if ctx is not None:
+                # Cross-process delivery: record the recv at the wire
+                # boundary (the engine ingress skips contexts marked
+                # recorded), attach the context for downstream consumers,
+                # and feed the clock-offset estimator — send/recv pairs
+                # are the only cross-host clock evidence that exists.
+                recv_us = time.perf_counter_ns() // 1000
+                clock.observe(ctx.origin, ctx.sent_us, recv_us)
+                message.trace_ctx = ctx
+                if trace.enabled():
+                    trace.instant(
+                        "net.recv",
+                        track=self.node,
+                        origin=ctx.origin,
+                        height=ctx.height,
+                        round=ctx.round,
+                        type=int(message.type),
+                        span=ctx.span_id,
+                        sent_us=ctx.sent_us,
+                        transport="grpc",
+                    )
+                    # Only suppress the engine's own record when this
+                    # transport carries the engine's track: otherwise the
+                    # canonical per-node recv would land on a ``net-*``
+                    # diagnostics row and the timeline would see no
+                    # arrivals at the node.
+                    if self._node_explicit:
+                        ctx.recorded = True
             self._deliver(message)
             return b""
 
@@ -161,6 +202,11 @@ class GrpcTransport:
             "net.multicast", peers=len(self._stubs), type=int(message.type)
         ):
             payload = message.encode()
+            # Trace-context frame AROUND the signed bytes (never inside:
+            # payload_no_sig must stay byte-identical to the reference).
+            ctx = getattr(message, "trace_ctx", None)
+            if ctx is not None:
+                payload = encode_traced(payload, ctx)
             self._deliver(message)
         for name, stub in self._stubs.items():
             task = asyncio.get_running_loop().create_task(

@@ -23,13 +23,16 @@ Three pillars (ISSUE 4):
   CPU-fallback rounds still catch regressions without a chip.
 """
 
-from . import trace
+from . import clock, trace
 from .evidence import EvidenceWriter, Fingerprint, probe_fingerprint
 from .export import to_chrome_trace, write_chrome_trace
-from .gates import GateResult, gate_evidence, render_table
+from .gates import GateResult, gate_evidence, gate_slo_records, render_table
+from .httpd import TelemetryServer
+from .metrics_export import render_prometheus
 from .recorder import RingRecorder
 
 __all__ = [
+    "clock",
     "trace",
     "EvidenceWriter",
     "Fingerprint",
@@ -38,6 +41,9 @@ __all__ = [
     "write_chrome_trace",
     "GateResult",
     "gate_evidence",
+    "gate_slo_records",
     "render_table",
     "RingRecorder",
+    "TelemetryServer",
+    "render_prometheus",
 ]

@@ -34,7 +34,13 @@ _PID = 0
 _CAT = "obs"
 
 
-def to_chrome_trace(records: Iterable[Record], dropped: int = 0) -> dict:
+def to_chrome_trace(
+    records: Iterable[Record],
+    dropped: int = 0,
+    *,
+    node: Optional[str] = None,
+    clock_offsets: Optional[dict] = None,
+) -> dict:
     """Recorder records -> a Chrome trace-event JSON object (as a dict).
 
     ``dropped`` (records overwritten after the ring filled) is surfaced in
@@ -42,6 +48,13 @@ def to_chrome_trace(records: Iterable[Record], dropped: int = 0) -> dict:
     visible in the artifact itself: spans near the wrap boundary may have
     lost their children, and tooling must not treat such a trace as a
     complete record.
+
+    ``node`` stamps the exporting process's node identity and
+    ``clock_offsets`` its per-peer clock-offset estimates
+    (:mod:`go_ibft_tpu.obs.clock` snapshot) into ``otherData`` — the
+    cross-process telemetry plane's export contract: the timeline tool
+    merges N per-node files and needs both to rebase foreign timestamps.
+    A loopback export carries an empty offsets map (one shared clock).
     """
     records = list(records)
     base = min((r[3] for r in records), default=0)
@@ -74,30 +87,47 @@ def to_chrome_trace(records: Iterable[Record], dropped: int = 0) -> dict:
         elif ph == "i":
             event["s"] = "t"  # thread-scoped instant
         events.append(event)
+    other = {"droppedRecords": dropped}
+    if node is not None:
+        other["node"] = node
+    if clock_offsets is not None:
+        other["clockOffsetsUs"] = clock_offsets
     return {
         "displayTimeUnit": "ms",
-        "otherData": {"droppedRecords": dropped},
+        "otherData": other,
         "traceEvents": events,
     }
 
 
 def write_chrome_trace(
-    path: str, recorder: Optional[RingRecorder] = None
+    path: str,
+    recorder: Optional[RingRecorder] = None,
+    *,
+    node: Optional[str] = None,
+    clock_offsets: Optional[dict] = None,
 ) -> int:
     """Export ``recorder`` (default: the active trace recorder) to ``path``.
 
     Returns the number of trace events written (metadata included).  An
     empty or missing recorder still writes a valid empty trace, so a
     ``--trace`` run that recorded nothing leaves a loadable artifact
-    rather than a crash.
+    rather than a crash.  ``clock_offsets`` defaults to the process-global
+    :mod:`~go_ibft_tpu.obs.clock` snapshot whenever ``node`` is given (a
+    per-node export is exactly the cross-process case that needs it).
     """
     if recorder is None:
         from . import trace
 
         recorder = trace.recorder()
+    if clock_offsets is None and node is not None:
+        from . import clock
+
+        clock_offsets = clock.snapshot()
     doc = to_chrome_trace(
         recorder.snapshot() if recorder is not None else [],
         dropped=recorder.dropped if recorder is not None else 0,
+        node=node,
+        clock_offsets=clock_offsets,
     )
     with open(path, "w") as fh:
         json.dump(doc, fh)

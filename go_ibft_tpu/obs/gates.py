@@ -40,6 +40,12 @@ __all__ = [
     "render_table",
     "WARN_PCT",
     "FAIL_PCT",
+    "SloSpec",
+    "DEFAULT_SLO_TABLE",
+    "slo_record",
+    "append_slo_records",
+    "parse_slo_records",
+    "gate_slo_records",
 ]
 
 WARN_PCT = 10.0
@@ -297,3 +303,167 @@ def render_table(results: List[GateResult]) -> str:
         if i == 0:
             out.append("-" * len(out[0]))
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# SLO soak gates (cross-process telemetry plane)
+# ---------------------------------------------------------------------------
+#
+# The bench gates above compare a fresh measurement against the best PRIOR
+# measurement; soak SLOs are absolute contracts instead — liveness either
+# held or it did not, regardless of history.  The soaks (chain soak, chaos
+# matrix, timeline smoke) emit one JSONL record per SLO::
+#
+#     {"slo": "missed_heights", "value": 0, "unit": "heights",
+#      "warn": 0, "fail": 0, "context": {"nodes": 30, "heights": 20}}
+#
+# and this gate grades each record direction-aware against its limits
+# (per-record limits win; DEFAULT_SLO_TABLE supplies the standing ones).
+# ``value > fail`` (or ``< fail`` for higher-is-better SLOs) fails the
+# run the same way a perf regression does — CI treats both alike.
+
+
+@dataclass
+class SloSpec:
+    """Standing limits for one SLO family."""
+
+    warn: Optional[float]
+    fail: Optional[float]
+    higher_is_better: bool = False
+    unit: str = ""
+
+
+DEFAULT_SLO_TABLE: Dict[str, SloSpec] = {
+    # Liveness: ANY missed height is a failure — the cross-process
+    # missed_heights=0 posture of bench config #12's QoS gate, applied to
+    # every soak.
+    "missed_heights": SloSpec(warn=0, fail=0, unit="heights"),
+    # Safety proxy: divergent per-node chains (should be impossible; the
+    # soaks also assert it directly, but the record makes CI evidence).
+    "diverged_chains": SloSpec(warn=0, fail=0, unit="nodes"),
+    # Latency: per-height finalize tail under chaos.  The standing limits
+    # are deliberately loose (CI hosts vary wildly); individual soaks
+    # pass tighter per-record limits scaled to their round timeout.
+    "finalize_p99_ms": SloSpec(warn=10_000.0, fail=30_000.0, unit="ms"),
+    # Degradation budgets: shed verify work and quarantined lanes are
+    # legitimate under injected faults but a sudden flood of either is a
+    # regression in disguise.
+    "shed_lanes": SloSpec(warn=0, fail=None, unit="lanes"),
+    "quarantined_lanes": SloSpec(warn=0, fail=None, unit="lanes"),
+    # Sync should only ever repair stranded tails, never carry the soak.
+    "sync_fraction": SloSpec(warn=0.25, fail=0.5, unit="fraction"),
+}
+
+
+def slo_record(
+    name: str,
+    value: float,
+    *,
+    warn: Optional[float] = None,
+    fail: Optional[float] = None,
+    unit: Optional[str] = None,
+    context: Optional[dict] = None,
+) -> dict:
+    """Build one SLO record (explicit limits override the table's)."""
+    spec = DEFAULT_SLO_TABLE.get(name)
+    record = {
+        "slo": name,
+        "value": value,
+        "warn": warn if warn is not None else (spec.warn if spec else None),
+        "fail": fail if fail is not None else (spec.fail if spec else None),
+        "unit": unit if unit is not None else (spec.unit if spec else ""),
+    }
+    if context:
+        record["context"] = context
+    return record
+
+
+def append_slo_records(path: Optional[str], records: Iterable[dict]) -> None:
+    """Append records as JSONL (no-op on ``None`` path — soaks call this
+    unconditionally and the env var decides whether evidence lands)."""
+    if not path:
+        return
+    with open(path, "a") as fh:
+        for record in records:
+            fh.write(json.dumps(record) + "\n")
+
+
+def parse_slo_records(path: str) -> List[dict]:
+    """Parse one SLO JSONL file (lines without a ``slo`` key are skipped)."""
+    records: List[dict] = []
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(line, dict) and "slo" in line:
+                records.append(line)
+    return records
+
+
+def gate_slo_records(
+    records: Iterable[dict],
+    table: Optional[Dict[str, SloSpec]] = None,
+) -> List[GateResult]:
+    """Grade SLO records pass/warn/fail against absolute limits.
+
+    Reuses :class:`GateResult` (and therefore :func:`render_table`):
+    ``prior`` holds the fail limit, ``change_pct`` the headroom consumed.
+    Records naming an SLO with no limits anywhere report ``info``.
+    """
+    table = DEFAULT_SLO_TABLE if table is None else table
+    results: List[GateResult] = []
+    for record in records:
+        name = str(record.get("slo"))
+        value = record.get("value")
+        spec = table.get(name)
+        higher = spec.higher_is_better if spec else False
+        warn = record.get("warn", spec.warn if spec else None)
+        fail = record.get("fail", spec.fail if spec else None)
+        note = ""
+        context = record.get("context")
+        if context:
+            note = str(context)[:60]
+        if not isinstance(value, (int, float)):
+            results.append(
+                GateResult(
+                    name, "slo", "warn", None, fail, "slo-limit", None,
+                    note="record carries no numeric value",
+                )
+            )
+            continue
+        value = float(value)
+
+        def breached(limit: Optional[float]) -> bool:
+            if limit is None:
+                return False
+            return value < limit if higher else value > limit
+
+        if breached(fail):
+            status = "fail"
+        elif breached(warn):
+            status = "warn"
+        elif warn is None and fail is None:
+            status = "info"
+        else:
+            status = "pass"
+        headroom = None
+        if fail not in (None, 0):
+            headroom = round(value / fail * 100.0, 1)
+        results.append(
+            GateResult(
+                name,
+                "slo",
+                status,
+                value,
+                fail,
+                "slo-limit",
+                headroom,
+                note=note,
+            )
+        )
+    return results

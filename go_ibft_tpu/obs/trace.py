@@ -33,6 +33,7 @@ Design rules (ISSUE 4 tentpole):
 from __future__ import annotations
 
 import contextvars
+import itertools
 import threading
 import time
 from typing import Optional
@@ -47,6 +48,7 @@ __all__ = [
     "span",
     "instant",
     "set_track",
+    "next_span_id",
 ]
 
 # THE predicate: every instrumentation site checks this one global.
@@ -76,6 +78,16 @@ def enabled() -> bool:
 
 def recorder() -> Optional[RingRecorder]:
     return _recorder
+
+
+_span_id_counter = itertools.count(1)
+
+
+def next_span_id() -> int:
+    """Fresh per-process span id (links a ``net.send`` to its ``net.recv``
+    records across nodes; ``itertools.count.__next__`` is atomic under the
+    GIL, so transport threads need no lock)."""
+    return next(_span_id_counter)
 
 
 def set_track(name: str) -> contextvars.Token:

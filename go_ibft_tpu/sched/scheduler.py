@@ -97,6 +97,10 @@ DISPATCHES_KEY = ("go-ibft", "sched", "dispatches")
 COALESCED_REQUESTS_KEY = ("go-ibft", "sched", "coalesced_requests")
 DRAIN_MS_KEY = ("go-ibft", "sched", "drain_ms")
 FLUSH_FAULTS_KEY = ("go-ibft", "sched", "flush_faults")
+# Fixed-bucket per-tenant drain latency for the /metrics endpoint (the
+# tenant chain id renders as the ``tag`` label; off unless
+# metrics.enable_fixed_histograms() ran).
+SCHED_DRAIN_MS_FIXED_KEY = ("go-ibft", "latency", "sched_drain_ms")
 
 
 # Tenant QoS classes: lower rank is selected first (ISSUE 10).  Consensus
@@ -643,6 +647,9 @@ class TenantScheduler:
                 req.tenant.requests += 1
                 req.tenant.lanes += req.lanes
             metrics.observe(DRAIN_MS_KEY, dt_ms)
+            metrics.observe_fixed(
+                SCHED_DRAIN_MS_FIXED_KEY + (req.tenant.chain_id,), dt_ms
+            )
             req.done.set()
 
     # -- evidence --------------------------------------------------------

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
@@ -73,6 +74,9 @@ SERVE_PROOFS_KEY = ("go-ibft", "serve", "proofs_served")
 SERVE_VERIFY_LANES_KEY = ("go-ibft", "serve", "verify_lanes")
 SERVE_SIG_HITS_KEY = ("go-ibft", "serve", "sig_cache_hits")
 SERVE_PAIRINGS_KEY = ("go-ibft", "serve", "pairings")
+# Fixed-bucket proof-serving latency for the /metrics endpoint (off
+# unless metrics.enable_fixed_histograms() ran).
+SERVE_PROOF_MS_KEY = ("go-ibft", "latency", "serve_proof_ms")
 
 _VERIFIER_IDS = itertools.count()
 
@@ -458,6 +462,7 @@ class ProofServer:
         a cold client loops).  Raises :class:`ProofError` when the range
         is empty or the chain cannot serve it.
         """
+        t0 = time.perf_counter() if metrics.fixed_histograms_enabled() else None
         latest = self.builder.latest_height()
         if target is None:
             target = latest
@@ -489,6 +494,10 @@ class ProofServer:
         with self._stats_lock:
             self.proofs_served += 1
         metrics.inc_counter(SERVE_PROOFS_KEY)
+        if t0 is not None:
+            metrics.observe_fixed(
+                SERVE_PROOF_MS_KEY, (time.perf_counter() - t0) * 1e3
+            )
         return FinalityProof(
             checkpoint_height=checkpoint_height, entries=entries, diffs=diffs
         )

@@ -133,6 +133,11 @@ def host_quorum_reached(
 EARLY_EXIT_SKIPPED_KEY = ("go-ibft", "early_exit", "lanes_skipped")
 EARLY_EXIT_DRAINS_KEY = ("go-ibft", "early_exit", "drains")
 
+# Fixed-bucket drain-latency family for the live /metrics endpoint: one
+# series per route (the key's 4th part renders as the ``tag`` label).
+# Recorded only while metrics.enable_fixed_histograms() is on.
+VERIFY_DRAIN_MS_KEY = ("go-ibft", "latency", "verify_drain_ms")
+
 
 @dataclass
 class EarlyExitReport:
@@ -216,6 +221,7 @@ class HostBatchVerifier:
 
     def verify_senders(self, msgs: Sequence[IbftMessage]) -> np.ndarray:
         out = np.zeros(len(msgs), dtype=bool)
+        t0 = time.perf_counter() if metrics.fixed_histograms_enabled() else None
         with trace.span(
             "verify.drain", kind="senders", route="host", lanes=len(msgs)
         ):
@@ -249,6 +255,11 @@ class HostBatchVerifier:
                         host_ecdsa.pubkey_to_address(*pub) == msg.sender
                         and self._is_member(msg.view.height, msg.sender)
                     )
+        if t0 is not None:
+            metrics.observe_fixed(
+                VERIFY_DRAIN_MS_KEY + ("host",),
+                (time.perf_counter() - t0) * 1e3,
+            )
         return out
 
     def verify_committed_seals(
@@ -260,6 +271,7 @@ class HostBatchVerifier:
         # recover also reads exactly 32 digest bytes).
         if len(proposal_hash) != 32:
             return out
+        t0 = time.perf_counter() if metrics.fixed_histograms_enabled() else None
         with trace.span(
             "verify.drain", kind="seals", route="host", lanes=len(seals)
         ):
@@ -287,6 +299,11 @@ class HostBatchVerifier:
                         host_ecdsa.pubkey_to_address(*pub) == seal.signer
                         and self._is_member(height, seal.signer)
                     )
+        if t0 is not None:
+            metrics.observe_fixed(
+                VERIFY_DRAIN_MS_KEY + ("host",),
+                (time.perf_counter() - t0) * 1e3,
+            )
         return out
 
     def verify_seal_lanes(
@@ -302,6 +319,7 @@ class HostBatchVerifier:
         equals ``height``'s (chain/sync.py does this by snapshot).
         """
         out = np.zeros(len(lanes), dtype=bool)
+        t0 = time.perf_counter() if metrics.fixed_histograms_enabled() else None
         with trace.span(
             "verify.drain", kind="seal_lanes", route="host", lanes=len(lanes)
         ):
@@ -332,6 +350,11 @@ class HostBatchVerifier:
                         host_ecdsa.pubkey_to_address(*pub) == seal.signer
                         and self._is_member(height, seal.signer)
                     )
+        if t0 is not None:
+            metrics.observe_fixed(
+                VERIFY_DRAIN_MS_KEY + ("host",),
+                (time.perf_counter() - t0) * 1e3,
+            )
         return out
 
     def verify_seals_early_exit(
@@ -366,6 +389,7 @@ class HostBatchVerifier:
             return EarlyExitReport(mask, verified, thr <= 0, 0)
         tally = _PowerTally(powers, thr)
         done = 0
+        t0 = time.perf_counter() if metrics.fixed_histograms_enabled() else None
         with trace.span(
             "verify.early_exit", route="host", kind="seals", lanes=n
         ):
@@ -394,6 +418,11 @@ class HostBatchVerifier:
         metrics.inc_counter(EARLY_EXIT_DRAINS_KEY)
         if skipped:
             metrics.inc_counter(EARLY_EXIT_SKIPPED_KEY, skipped)
+        if t0 is not None:
+            metrics.observe_fixed(
+                VERIFY_DRAIN_MS_KEY + ("host",),
+                (time.perf_counter() - t0) * 1e3,
+            )
         return EarlyExitReport(mask, verified, tally.reached, skipped)
 
 
@@ -1149,9 +1178,9 @@ class DeviceBatchVerifier:
         mask, reached = self._readback(
             self._dispatch_async(inputs, table, quorum_args)
         )
-        metrics.observe(
-            ("go-ibft", "device", metric), (time.perf_counter() - t0) * 1e3
-        )
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        metrics.observe(("go-ibft", "device", metric), dt_ms)
+        metrics.observe_fixed(VERIFY_DRAIN_MS_KEY + ("device",), dt_ms)
         return mask, reached
 
     # Largest payload the device digest path can absorb; one byte is

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Reconstruct a cross-node consensus timeline from flight-recorder traces.
+
+Usage::
+
+    python scripts/consensus_timeline.py node0.json [node1.json ...]
+        [--quorum N] [--perfetto merged.json] [--json timeline.json]
+
+Inputs are ``obs/export.py`` Chrome-trace documents — either one
+multi-track loopback export or N per-node exports from a cross-process
+deployment (their clocks are aligned via the per-file
+``otherData.clockOffsetsUs`` estimates).  Prints the per-height critical
+path (which validator's message completed each quorum, the time split
+between proposal broadcast / PREPARE quorum / COMMIT quorum / finalize
+tail with verify, drain, and wakeup attribution) and optionally writes a
+merged multi-process Perfetto file plus the raw reconstruction as JSON.
+
+Exit code 0 when at least one height reconstructed, 2 when the traces
+hold no consensus traffic (e.g. tracing was enabled but no ``net.send``
+records landed), 1 on unreadable input.  A nonzero per-file
+``droppedRecords`` is surfaced as a warning — a wrapped ring means the
+window is NOT a complete record and early heights may be missing.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from go_ibft_tpu.obs import timeline  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("traces", nargs="+", help="per-node trace JSON files")
+    parser.add_argument(
+        "--quorum",
+        type=int,
+        default=None,
+        help="quorum size (default: derived from the node count, equal powers)",
+    )
+    parser.add_argument(
+        "--perfetto",
+        metavar="OUT_JSON",
+        default=None,
+        help="write the merged multi-process Perfetto document here",
+    )
+    parser.add_argument(
+        "--json",
+        metavar="OUT_JSON",
+        default=None,
+        help="write the reconstructed timelines (one dict per height) here",
+    )
+    args = parser.parse_args()
+
+    files = []
+    for path in args.traces:
+        try:
+            files.append(timeline.load_trace_file(path))
+        except (OSError, ValueError, KeyError) as err:
+            print(f"consensus_timeline: cannot parse {path!r}: {err}", file=sys.stderr)
+            return 1
+    for trace_file in files:
+        if trace_file.dropped:
+            print(
+                f"WARNING: {trace_file.path} dropped {trace_file.dropped} "
+                "records (ring wrapped) — the timeline window is incomplete",
+                file=sys.stderr,
+            )
+
+    merged = timeline.merge_events(files)
+    timelines = timeline.reconstruct(merged, quorum=args.quorum)
+
+    if args.perfetto:
+        with open(args.perfetto, "w") as fh:
+            json.dump(timeline.to_perfetto(files), fh)
+        print(f"perfetto: {args.perfetto}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump([tl.to_dict() for tl in timelines], fh, indent=2)
+
+    if not timelines:
+        print(
+            "consensus_timeline: no consensus traffic in the given traces "
+            "(was tracing enabled during the run?)",
+            file=sys.stderr,
+        )
+        return 2
+    print(timeline.render_report(timelines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,23 @@ def main() -> int:
     )
 
     print(f"evidence: {args.evidence}  backend: {backend}")
+    # "No silent caps": a --trace run whose ring wrapped produced a
+    # TRUNCATED flight-recorder window — say so next to the numbers, or a
+    # partial timeline reads as a complete one.
+    for line in fresh:
+        if line.get("metric") == "trace_export":
+            dropped = line.get("dropped_records", 0) or 0
+            if dropped:
+                print(
+                    f"WARNING: trace export {line.get('path')!r} dropped "
+                    f"{dropped} records (ring wrapped) — the trace window "
+                    "is incomplete"
+                )
+            else:
+                print(
+                    f"trace export: {line.get('path')!r} "
+                    f"({line.get('value')} events, 0 dropped)"
+                )
     print(gates.render_table(results))
     statuses = {r.status for r in results}
     bad = {"fail"} if args.fail_on == "fail" else {"fail", "warn"}

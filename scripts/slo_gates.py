@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""SLO gate report: grade soak-emitted SLO records pass/warn/fail.
+
+Usage::
+
+    python scripts/slo_gates.py [--slo slo.jsonl ...] [--fail-on fail|warn|never]
+
+Parses one or more SLO JSONL files (the chain soak, the chaos matrix, and
+``scripts/timeline_smoke.py`` append records when ``GO_IBFT_SLO_PATH`` is
+set — or pass explicit paths) and grades every record against its limits
+(per-record ``warn``/``fail`` fields win; ``obs/gates.py::
+DEFAULT_SLO_TABLE`` supplies the standing ones).  Liveness SLOs like
+``missed_heights`` are absolute contracts: ANY breach fails CI the same
+way a perf regression does (``make slo-gates``).
+
+Exit code: 0 unless a row at or above ``--fail-on`` severity exists
+(default ``fail``); 2 when no records could be read at all.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from go_ibft_tpu.obs import gates  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--slo",
+        action="append",
+        default=None,
+        metavar="PATH",
+        help="SLO JSONL file(s); default $GO_IBFT_SLO_PATH or slo.jsonl",
+    )
+    parser.add_argument(
+        "--fail-on",
+        choices=("fail", "warn", "never"),
+        default="fail",
+        help="lowest severity that makes the exit code nonzero",
+    )
+    args = parser.parse_args()
+
+    paths = args.slo or [os.environ.get("GO_IBFT_SLO_PATH") or "slo.jsonl"]
+    records = []
+    for path in paths:
+        try:
+            records.extend(gates.parse_slo_records(path))
+        except OSError as err:
+            print(f"slo_gates: cannot read {path!r} ({err})", file=sys.stderr)
+    if not records:
+        print(
+            "slo_gates: no SLO records found — run a soak with "
+            "GO_IBFT_SLO_PATH set (make timeline-smoke / make chain-soak)",
+            file=sys.stderr,
+        )
+        return 2
+
+    results = gates.gate_slo_records(records)
+    print(gates.render_table(results))
+    statuses = {r.status for r in results}
+    bad = {"fail"} if args.fail_on == "fail" else {"fail", "warn"}
+    if args.fail_on != "never" and statuses & bad:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Telemetry-plane smoke: live endpoints + timeline + SLO gates in one run.
+
+The CI entry (`make timeline-smoke`) for the cross-process telemetry
+plane.  Boots a 4-node loopback ChainRunner cluster with tracing and the
+fixed-bucket histograms ON and the /metrics,/healthz,/statusz endpoints
+mounted on node 0, then — while the chain is still finalizing heights —
+scrapes all three endpoints and validates them (Prometheus text parses,
+healthz is 200/ok, statusz carries the pinned schema).  After the run it
+exports the flight recorder, reconstructs the per-height consensus
+timeline (`go_ibft_tpu.obs.timeline`), emits SLO records
+(missed_heights, finalize p99, quarantine/shed counts) and grades them
+through the SLO gates.  Exit 0 iff every step held.
+
+    python scripts/timeline_smoke.py [--nodes 4] [--heights 3]
+        [--trace-out DIR] [--slo-out slo.jsonl]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Null:
+    def info(self, *a):
+        pass
+
+    debug = error = info
+
+
+def _scrape(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+STATUSZ_REQUIRED = (
+    "node",
+    "height",
+    "round",
+    "state",
+    "chain_height",
+    "heights_run",
+    "breaker_level",
+    "speculation",
+    "ring_dropped",
+)
+
+
+async def _run(args, tmp: str) -> int:
+    from go_ibft_tpu.chain import ChainRunner, WriteAheadLog
+    from go_ibft_tpu.core import IBFT, BatchingIngress
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.obs import gates, timeline, trace
+    from go_ibft_tpu.obs.metrics_export import parse_exposition
+    from go_ibft_tpu.utils import metrics
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    n, heights = args.nodes, args.heights
+    metrics.reset()
+    trace.enable(1 << 18)
+    keys = [PrivateKey.from_seed(b"tlsmoke-%d" % i) for i in range(n)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    nodes = []
+
+    def gossip(message):
+        for _, ingress in nodes:
+            ingress.submit(message)
+
+    class _T:
+        def multicast(self, message):
+            gossip(message)
+
+    runners = []
+    for i, key in enumerate(keys):
+        core = IBFT(
+            _Null(),
+            ECDSABackend(key, src),
+            _T(),
+            batch_verifier=HostBatchVerifier(src),
+        )
+        core.set_base_round_timeout(10.0)
+        ingress = BatchingIngress(core.add_messages)
+        nodes.append((core, ingress))
+        runners.append(
+            ChainRunner(core, WriteAheadLog(os.path.join(tmp, f"wal-{i}.jsonl")))
+        )
+
+    server = runners[0].start_telemetry(port=0)
+    failures = []
+    try:
+        tasks = [
+            asyncio.create_task(r.run(until_height=heights)) for r in runners
+        ]
+
+        # Scrape WHILE the chain finalizes (the acceptance criterion).
+        for _ in range(2000):
+            if runners[0].latest_height() >= 1:
+                break
+            await asyncio.sleep(0.005)
+        loop = asyncio.get_running_loop()
+        code, text = await loop.run_in_executor(
+            None, _scrape, server.url + "/metrics"
+        )
+        series = parse_exposition(text)  # raises on malformed exposition
+        if code != 200:
+            failures.append(f"/metrics returned {code}")
+        if not any(k.startswith("go_ibft_latency_") for k in series):
+            failures.append("/metrics holds no go_ibft_latency_* series")
+        code, text = await loop.run_in_executor(
+            None, _scrape, server.url + "/healthz"
+        )
+        health = json.loads(text)
+        if code != 200 or not health.get("ok"):
+            failures.append(f"/healthz unhealthy mid-run: {health}")
+        code, text = await loop.run_in_executor(
+            None, _scrape, server.url + "/statusz"
+        )
+        status = json.loads(text)
+        missing = [k for k in STATUSZ_REQUIRED if k not in status]
+        if code != 200 or missing:
+            failures.append(f"/statusz missing keys: {missing}")
+
+        await asyncio.wait_for(asyncio.gather(*tasks), 120)
+    finally:
+        for core, ingress in nodes:
+            ingress.close()
+            core.messages.close()
+        server.stop()
+
+    # -- timeline reconstruction over the run's own trace ---------------
+    trace_dir = args.trace_out or tmp
+    trace_path = os.path.join(trace_dir, "timeline_smoke_trace.json")
+    runners[0].export_trace(trace_path)
+    trace_file = timeline.load_trace_file(trace_path)
+    merged = timeline.merge_events([trace_file])
+    timelines = timeline.reconstruct(merged)
+    finalized = [
+        tl for tl in timelines if tl.critical_node is not None
+    ]
+    if len(finalized) < heights:
+        failures.append(
+            f"timeline reconstructed {len(finalized)}/{heights} heights"
+        )
+    for tl in finalized:
+        split = tl.to_dict()["critical_path"]
+        if split["commit_completer"] is None:
+            failures.append(f"height {tl.height}: no COMMIT quorum completer")
+    print(timeline.render_report(timelines))
+    print()
+
+    # -- SLO records + gates ---------------------------------------------
+    missed = sum(max(0, heights - len(r.chain)) for r in runners)
+    p99 = metrics.percentile(
+        metrics.get_histogram(("go-ibft", "chain", "height_ms")), 0.99
+    )
+    records = [
+        gates.slo_record(
+            "missed_heights", missed, context={"nodes": n, "heights": heights}
+        ),
+        gates.slo_record(
+            "quarantined_lanes",
+            metrics.get_counter(("go-ibft", "resilient", "quarantined_lanes")),
+        ),
+        gates.slo_record(
+            "shed_lanes", metrics.get_counter(("go-ibft", "sched", "shed_lanes"))
+        ),
+    ]
+    if p99 is None:
+        # A run that recorded no height latencies would make the latency
+        # SLO silently vacuous — that is a smoke failure, not a pass.
+        failures.append("no chain height_ms samples recorded")
+    else:
+        records.append(
+            gates.slo_record("finalize_p99_ms", p99, fail=60_000.0)
+        )
+    gates.append_slo_records(args.slo_out, records)
+    results = gates.gate_slo_records(records)
+    print(gates.render_table(results))
+    if any(r.status == "fail" for r in results):
+        failures.append("SLO gate failed")
+
+    if failures:
+        for failure in failures:
+            print(f"FAIL: {failure}", file=sys.stderr)
+        return 1
+    print(
+        f"\ntimeline smoke OK: {n} nodes x {heights} heights, "
+        f"{len(series)} metric series, {len(finalized)} heights reconstructed"
+    )
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--heights", type=int, default=3)
+    parser.add_argument(
+        "--trace-out", default=None, help="keep the trace export here"
+    )
+    parser.add_argument(
+        "--slo-out",
+        default=os.environ.get("GO_IBFT_SLO_PATH"),
+        help="append SLO records here (JSONL; default $GO_IBFT_SLO_PATH)",
+    )
+    args = parser.parse_args()
+    with tempfile.TemporaryDirectory() as tmp:
+        return asyncio.run(_run(args, tmp))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,15 @@ class _ChaosChainCluster:
 
 async def _soak(tmp_path, seed, *, n, heights, deadline, timeout=1.0):
     metrics.reset()
+    # Optional telemetry artifact: GO_IBFT_SOAK_TRACE=<path> records the
+    # soak's flight-recorder spans (net.send/net.recv propagation
+    # included) and exports a trace scripts/consensus_timeline.py
+    # reconstructs — the chaos-matrix entry of the ISSUE 11 plane.
+    trace_path = os.environ.get("GO_IBFT_SOAK_TRACE")
+    if trace_path:
+        from go_ibft_tpu.obs import trace as obs_trace
+
+        obs_trace.enable(1 << 18)
     injector = FaultInjector(seed, _SOAK_CFG)
     with replay_on_failure(injector):
         cluster = _ChaosChainCluster(tmp_path, n, injector, timeout=timeout)
@@ -124,10 +133,68 @@ async def _soak(tmp_path, seed, *, n, heights, deadline, timeout=1.0):
                 metrics.counters_snapshot(("go-ibft", "chaos")).values()
             )
             assert injected > 0, "chaos schedule injected no faults"
+            # SLO gate (ISSUE 11): the soak's liveness contract as graded
+            # evidence — CI fails on a liveness regression exactly like a
+            # perf regression (obs/gates.py); GO_IBFT_SLO_PATH persists
+            # the records for scripts/slo_gates.py.
+            _gate_soak_slos(
+                cluster, n=n, heights=heights, seed=seed, timeout=timeout
+            )
         finally:
             cluster.close()
+            if trace_path:
+                from go_ibft_tpu.obs import trace as obs_trace
+                from go_ibft_tpu.obs.export import write_chrome_trace
+
+                write_chrome_trace(
+                    trace_path, node=f"soak-n{n}-seed{seed}"
+                )
+                obs_trace.disable()
             # let chaotic call_later deliveries land before the leak check
             await asyncio.sleep(0.03)
+
+
+def _gate_soak_slos(cluster, *, n, heights, seed, timeout):
+    from go_ibft_tpu.obs import gates
+
+    missed = sum(
+        max(0, heights - len(runner.chain)) for runner in cluster.runners
+    )
+    chains = [
+        [b.proposal.raw_proposal for b in runner.chain]
+        for runner in cluster.runners
+    ]
+    diverged = sum(1 for c in chains if c != chains[0])
+    p99 = metrics.percentile(
+        metrics.get_histogram(("go-ibft", "chain", "height_ms")), 0.99
+    )
+    assert p99 is not None, "soak recorded no chain height_ms samples"
+    synced = sum(r.synced_heights for r in cluster.runners)
+    records = [
+        gates.slo_record(
+            "missed_heights",
+            missed,
+            context={"soak": "chain", "nodes": n, "heights": heights, "seed": seed},
+        ),
+        gates.slo_record("diverged_chains", diverged),
+        # Rounds legitimately change under chaos: a height may wait out
+        # full round timeouts.  Budget a few, then fail.
+        gates.slo_record(
+            "finalize_p99_ms",
+            p99,
+            warn=2 * timeout * 1e3,
+            fail=8 * timeout * 1e3,
+        ),
+        gates.slo_record(
+            "quarantined_lanes",
+            metrics.get_counter(("go-ibft", "resilient", "quarantined_lanes")),
+        ),
+        gates.slo_record("sync_fraction", synced / (n * heights)),
+    ]
+    gates.append_slo_records(os.environ.get("GO_IBFT_SLO_PATH"), records)
+    results = gates.gate_slo_records(records)
+    failed = [r for r in results if r.status == "fail"]
+    assert not failed, "SLO gate failed:\n" + gates.render_table(results)
 
 
 async def test_chain_chaos_smoke(tmp_path):

@@ -321,6 +321,42 @@ async def _soak(seed: int, heights: int) -> None:
                 metrics.counters_snapshot(("go-ibft", "chaos")).values()
             )
             assert injected > 0, "chaos schedule injected no faults"
+            # SLO gate (ISSUE 11): liveness evidence for the chaos matrix,
+            # graded exactly like perf evidence; GO_IBFT_SLO_PATH persists
+            # records for scripts/slo_gates.py.
+            import os as _os
+
+            from go_ibft_tpu.obs import gates
+
+            missed = sum(
+                max(0, heights - len(core.backend.inserted))
+                for core, _ in cluster.nodes
+            )
+            records = [
+                gates.slo_record(
+                    "missed_heights",
+                    missed,
+                    context={"soak": "chaos", "nodes": 6, "seed": seed},
+                ),
+                gates.slo_record(
+                    "quarantined_lanes",
+                    metrics.get_counter(
+                        ("go-ibft", "resilient", "quarantined_lanes")
+                    ),
+                ),
+                gates.slo_record(
+                    "sync_fraction",
+                    cluster.synced_heights / (heights * len(cluster.nodes)),
+                ),
+            ]
+            gates.append_slo_records(
+                _os.environ.get("GO_IBFT_SLO_PATH"), records
+            )
+            results = gates.gate_slo_records(records)
+            failed = [r for r in results if r.status == "fail"]
+            assert not failed, (
+                "SLO gate failed:\n" + gates.render_table(results)
+            )
         finally:
             cluster.close()
             # let chaotic call_later deliveries land before the leak check

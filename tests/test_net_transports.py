@@ -84,6 +84,78 @@ async def test_grpc_transport_cluster():
             e.messages.close()
 
 
+async def test_grpc_transport_propagates_trace_context():
+    """ISSUE 11: the trace context crosses the gRPC wire — framed AROUND
+    the signed bytes — and the receiving transport records ``net.recv``
+    at the wire boundary (once: the engine ingress skips contexts the
+    transport already recorded) and feeds the clock-offset estimator."""
+    from go_ibft_tpu.obs import clock, trace
+
+    clock.reset()
+    rec = trace.enable(1 << 15)
+    engines = _make_engines(4)
+    transports = []
+    try:
+        for i, e in enumerate(engines):
+            t = GrpcTransport(
+                "127.0.0.1:0", {}, e.add_message, node=f"wire-node-{i}"
+            )
+            await t.start()
+            transports.append(t)
+        for i, t in enumerate(transports):
+            for j, peer in enumerate(transports):
+                if i != j:
+                    t.add_peer(f"n{j}", f"127.0.0.1:{peer.bound_port}")
+        for e, t in zip(engines, transports):
+            e.transport = t
+
+        await _run_height(engines, 0)
+        records = rec.snapshot()
+        wire_recvs = [
+            r
+            for r in records
+            if r[1] == "net.recv" and r[5].get("transport") == "grpc"
+        ]
+        assert wire_recvs, "no wire-boundary net.recv recorded"
+        # Engine net.send instants carry a span id; the transport's
+        # per-peer net.send SPANS (peer=, attempt=) do not — filter.
+        sends = {
+            r[5]["span"]: r
+            for r in records
+            if r[1] == "net.send" and r[5] and "span" in r[5]
+        }
+        for r in wire_recvs:
+            assert r[2].startswith("wire-node-")  # the transport's track
+            assert r[5]["span"] in sends
+            assert r[5]["origin"] == sends[r[5]["span"]][2]
+        # One wire recv per (span, receiving transport): the engine did
+        # NOT double-record contexts the transport already recorded.
+        engine_recvs = [
+            r
+            for r in records
+            if r[1] == "net.recv" and "transport" not in r[5]
+        ]
+        engine_spans = {(r[5]["span"], r[2]) for r in engine_recvs}
+        wire_spans = {(r[5]["span"], r[2]) for r in wire_recvs}
+        # Engine-side recvs are exactly the loopback self-deliveries
+        # (sender track == recv track); wire recvs are everything else.
+        for span, track in engine_spans:
+            assert sends[span][2] == track
+        assert len(wire_spans) == len(wire_recvs)
+        # The wire pairs fed the clock-offset estimator.
+        snap = clock.snapshot()
+        assert snap, "no clock-offset samples recorded"
+        for origin, entry in snap.items():
+            assert origin.startswith("node-") and entry["samples"] >= 1
+    finally:
+        trace.disable()
+        clock.reset()
+        for t in transports:
+            await t.stop()
+        for e in engines:
+            e.messages.close()
+
+
 async def test_ici_lockstep_cluster():
     import jax
 

@@ -158,6 +158,28 @@ def test_export_empty_recorder_still_valid(tmp_path):
     assert json.loads(path.read_text())["traceEvents"] == []
 
 
+def test_export_surfaces_dropped_node_and_clock_offsets(tmp_path):
+    """ISSUE 11 satellites: a wrapped ring is visible in the artifact
+    (droppedRecords), and a per-node export stamps node identity plus the
+    process's clock-offset estimates for the timeline tool."""
+    from go_ibft_tpu.obs import clock
+
+    rec = RingRecorder(2)
+    for i in range(5):
+        rec.append(("i", f"e{i}", "t", i, 0, None))
+    clock.reset()
+    clock.observe("node-peer", sent_us=1000, recv_us=1400)
+    try:
+        path = tmp_path / "node.json"
+        export.write_chrome_trace(str(path), rec, node="node-me")
+        other = json.loads(path.read_text())["otherData"]
+        assert other["droppedRecords"] == 3
+        assert other["node"] == "node-me"
+        assert other["clockOffsetsUs"]["node-peer"]["offset_us"] == 400
+    finally:
+        clock.reset()
+
+
 # ---------------------------------------------------------------------------
 # engine instrumentation: a multi-node height renders as multi-track
 # ---------------------------------------------------------------------------

@@ -1,0 +1,321 @@
+"""Telemetry plane: fixed histograms, /metrics, /healthz, /statusz.
+
+Pins the ISSUE 11 endpoint contracts:
+
+* fixed-bucket histograms are OFF by default behind one predicate and
+  record cumulative buckets + sum + count when enabled;
+* ``render_prometheus`` emits valid text exposition (every non-comment
+  line parses as ``series value``; histogram buckets are cumulative and
+  end at ``+Inf``);
+* a mounted :class:`TelemetryServer` serves all three endpoints; scrape
+  failures in the provider functions surface as HTTP 500, never a crash;
+* ``/healthz`` flips to 503 on a wedged runner and recovers;
+* ``/statusz`` carries the pinned schema from a live ChainRunner.
+"""
+
+import asyncio
+import json
+import pathlib
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from go_ibft_tpu.obs import metrics_export, trace  # noqa: E402
+from go_ibft_tpu.obs.httpd import TelemetryServer  # noqa: E402
+from go_ibft_tpu.utils import metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _metrics_reset():
+    metrics.reset()
+    metrics.disable_fixed_histograms()
+    yield
+    trace.disable()
+    metrics.disable_fixed_histograms()
+    metrics.reset()
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# fixed-bucket histograms
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_histograms_off_by_default_and_record_when_enabled():
+    key = ("go-ibft", "latency", "test_ms")
+    metrics.observe_fixed(key, 3.0)
+    assert metrics.fixed_histograms_snapshot() == {}  # disabled: no-op
+    metrics.enable_fixed_histograms()
+    metrics.observe_fixed(key, 3.0)
+    metrics.observe_fixed(key, 0.07)
+    metrics.observe_fixed(key, 99999.0)  # past the largest bound -> +Inf
+    snap = metrics.fixed_histograms_snapshot()[key]
+    assert snap["count"] == 3
+    assert snap["sum"] == pytest.approx(100002.07)
+    assert sum(snap["counts"]) == 3
+    assert snap["counts"][-1] == 1  # the +Inf bucket
+    # Bucket placement: 0.07 -> first bound >= 0.07 (0.1).
+    bounds = snap["bounds"]
+    assert snap["counts"][bounds.index(0.1)] == 1
+    metrics.disable_fixed_histograms()
+    metrics.observe_fixed(key, 5.0)
+    assert metrics.fixed_histograms_snapshot()[key]["count"] == 3
+
+
+def test_engine_hot_seams_record_fixed_histograms():
+    """The instrumented seams actually land samples: a happy-path height
+    with histograms ON produces accept->finalize, verify-drain and
+    WAL-append series."""
+    import os
+    import tempfile
+
+    from go_ibft_tpu.chain import ChainRunner, WriteAheadLog
+    from go_ibft_tpu.core import IBFT, LoopbackTransport
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    from harness import NullLogger
+
+    metrics.enable_fixed_histograms()
+    keys = [PrivateKey.from_seed(b"tel-%d" % i) for i in range(4)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    transport = LoopbackTransport()
+    engines = []
+    with tempfile.TemporaryDirectory() as tmp:
+        runners = []
+        for i, key in enumerate(keys):
+            engine = IBFT(
+                NullLogger(),
+                ECDSABackend(key, src),
+                transport,
+                batch_verifier=HostBatchVerifier(src),
+            )
+            engine.set_base_round_timeout(10.0)
+            transport.register(engine.add_message)
+            engines.append(engine)
+            runners.append(
+                ChainRunner(
+                    engine,
+                    WriteAheadLog(os.path.join(tmp, f"wal-{i}.jsonl")),
+                    overlap=False,
+                )
+            )
+
+        async def run():
+            await asyncio.wait_for(
+                asyncio.gather(*(r.run(until_height=1) for r in runners)), 60
+            )
+
+        try:
+            asyncio.run(run())
+        finally:
+            for engine in engines:
+                engine.messages.close()
+    snap = metrics.fixed_histograms_snapshot()
+    families = {k[:3] for k in snap}
+    assert ("go-ibft", "latency", "accept_finalize_ms") in families
+    assert ("go-ibft", "latency", "verify_drain_ms") in families
+    assert ("go-ibft", "latency", "wal_append_ms") in families
+    finalize = snap[("go-ibft", "latency", "accept_finalize_ms")]
+    assert finalize["count"] == 4  # one per node for the single height
+
+
+# ---------------------------------------------------------------------------
+# prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_exposition_parses_and_buckets_accumulate():
+    metrics.enable_fixed_histograms()
+    metrics.set_gauge(("go-ibft", "sequence", "duration"), 0.25)
+    metrics.inc_counter(("go-ibft", "transport", "retries"), 2)
+    metrics.observe(("go-ibft", "sched", "drain_ms"), 1.5)
+    for v in (0.3, 4.0, 40.0):
+        metrics.observe_fixed(("go-ibft", "latency", "verify_drain_ms", "host"), v)
+    text = metrics_export.render_prometheus()
+    series = metrics_export.parse_exposition(text)  # raises on bad lines
+    assert series["go_ibft_sequence_duration"] == 0.25
+    assert series["go_ibft_transport_retries_total"] == 2
+    assert series["go_ibft_sched_drain_ms_p50"] == 1.5
+    name = 'go_ibft_latency_verify_drain_ms_bucket{tag="host",le="%s"}'
+    # Cumulative: 0.5 holds the 0.3 sample; 5 adds 4.0; +Inf holds all.
+    assert series[name % "0.5"] == 1
+    assert series[name % "5"] == 2
+    assert series[name % "+Inf"] == 3
+    assert series['go_ibft_latency_verify_drain_ms_count{tag="host"}'] == 3
+    # Monotone non-decreasing across the whole bucket ladder.
+    buckets = [
+        v for k, v in series.items() if k.startswith("go_ibft_latency_verify")
+        and "_bucket" in k
+    ]
+    assert buckets == sorted(buckets)
+
+
+def test_metric_name_sanitizes_and_tags():
+    name, tag = metrics_export.metric_name(("go-ibft", "latency", "x_ms"))
+    assert (name, tag) == ("go_ibft_latency_x_ms", None)
+    name, tag = metrics_export.metric_name(
+        ("go-ibft", "latency", "sched_drain_ms", "chain-0")
+    )
+    assert name == "go_ibft_latency_sched_drain_ms"
+    assert tag == "chain-0"
+
+
+# ---------------------------------------------------------------------------
+# endpoint server
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_server_serves_all_three_endpoints():
+    metrics.enable_fixed_histograms()
+    metrics.observe_fixed(("go-ibft", "latency", "x_ms"), 1.0)
+    server = TelemetryServer(
+        status_fn=lambda: {"height": 7, "round": 0},
+        health_fn=lambda: (True, {"stale_s": 0.1}),
+    )
+    port = server.start()
+    try:
+        code, text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+        assert metrics_export.parse_exposition(text)["go_ibft_latency_x_ms_count"] == 1
+        code, text = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 200 and json.loads(text)["ok"] is True
+        code, text = _get(f"http://127.0.0.1:{port}/statusz")
+        assert code == 200 and json.loads(text)["height"] == 7
+        code, _ = _get(f"http://127.0.0.1:{port}/nope")
+        assert code == 404
+    finally:
+        server.stop()
+
+
+def test_unhealthy_and_crashing_providers():
+    calls = {"n": 0}
+
+    def flaky_status():
+        calls["n"] += 1
+        raise RuntimeError("boom")
+
+    server = TelemetryServer(
+        status_fn=flaky_status, health_fn=lambda: (False, {"wedged": True})
+    )
+    port = server.start()
+    try:
+        code, text = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 503 and json.loads(text)["ok"] is False
+        # A provider crash is a 500 to the scraper, never a dead server.
+        code, _ = _get(f"http://127.0.0.1:{port}/statusz")
+        assert code == 500
+        code, _ = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 503  # still serving after the crash
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ChainRunner mount: statusz schema + healthz wedge flip
+# ---------------------------------------------------------------------------
+
+
+def _mini_runner():
+    from go_ibft_tpu.chain import ChainRunner
+    from go_ibft_tpu.core import IBFT, LoopbackTransport
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    from harness import NullLogger
+
+    key = PrivateKey.from_seed(b"tel-runner")
+    src = ECDSABackend.static_validators({key.address: 1})
+    engine = IBFT(
+        NullLogger(),
+        ECDSABackend(key, src),
+        LoopbackTransport(),
+        batch_verifier=HostBatchVerifier(src),
+    )
+    return ChainRunner(engine, overlap=False)
+
+
+STATUSZ_SCHEMA = {
+    "node",
+    "running",
+    "height",
+    "round",
+    "state",
+    "next_height",
+    "chain_height",
+    "heights_run",
+    "synced_heights",
+    "overlapped_lanes",
+    "breaker_level",
+    "speculation",
+    "ring_dropped",
+    "handoff_ms_mean",
+}
+
+
+def test_statusz_schema_pinned_and_extra_status_merged():
+    runner = _mini_runner()
+    server = runner.start_telemetry(
+        port=0, extra_status={"sched": lambda: {"tenants": 0}}
+    )
+    try:
+        code, text = _get(server.url + "/statusz")
+        assert code == 200
+        status = json.loads(text)
+        assert STATUSZ_SCHEMA <= set(status), STATUSZ_SCHEMA - set(status)
+        assert status["sched"] == {"tenants": 0}
+        # Mounting telemetry turned the fixed histograms on.
+        assert metrics.fixed_histograms_enabled()
+    finally:
+        runner.stop_telemetry()
+
+
+def test_healthz_flips_on_wedged_runner_and_recovers():
+    import time as _time
+
+    runner = _mini_runner()
+    server = runner.start_telemetry(port=0, wedged_after_s=0.05)
+    try:
+        # Not running: healthy regardless of staleness.
+        code, text = _get(server.url + "/healthz")
+        assert code == 200 and json.loads(text)["wedged"] is False
+        # Simulate a wedged live runner: running, no height progress.
+        runner._running = True
+        runner._height_started = _time.monotonic() - 10.0
+        code, text = _get(server.url + "/healthz")
+        health = json.loads(text)
+        assert code == 503 and health["wedged"] is True
+        assert health["stale_s"] > 0.05
+        # Progress resets the verdict.
+        runner._height_started = _time.monotonic()
+        code, text = _get(server.url + "/healthz")
+        assert code == 200 and json.loads(text)["ok"] is True
+    finally:
+        runner.stop_telemetry()
+
+
+def test_ring_dropped_surfaces_in_statusz():
+    rec = trace.enable(4)
+    for i in range(10):
+        trace.instant("spam", track="t", i=i)
+    runner = _mini_runner()
+    server = runner.start_telemetry(port=0)
+    try:
+        code, text = _get(server.url + "/statusz")
+        assert code == 200
+        assert json.loads(text)["ring_dropped"] == rec.dropped > 0
+    finally:
+        runner.stop_telemetry()

@@ -1,0 +1,372 @@
+"""Telemetry plane: trace propagation + consensus timeline reconstruction.
+
+Pins the ISSUE 11 tentpole contracts:
+
+* ``TraceContext`` rides OUTSIDE the signed bytes (framing round-trips,
+  ``payload_no_sig`` unchanged, malformed frames degrade to no-context);
+* every outbound engine message records ``net.send`` and every delivery
+  ``net.recv`` with causally-linked span ids, on loopback dispatch;
+* the timeline reconstruction computes the correct per-height critical
+  path from a seeded deterministic schedule — quorum-completing sender
+  and phase durations pinned exactly;
+* cross-file clock alignment rebases foreign-process timestamps through
+  the exported clock-offset estimates;
+* a real 4-node cluster's trace reconstructs every finalized height.
+"""
+
+import asyncio
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from go_ibft_tpu.messages.wire import (  # noqa: E402
+    IbftMessage,
+    TraceContext,
+    View,
+    decode_traced,
+    encode_traced,
+)
+from go_ibft_tpu.obs import clock, export, timeline, trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _obs_off():
+    yield
+    trace.disable()
+    clock.reset()
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_codec_roundtrip():
+    ctx = TraceContext(
+        origin="node-ab12", height=7, round=2, sent_us=123_456_789, span_id=42
+    )
+    decoded = TraceContext.decode(ctx.encode())
+    assert (
+        decoded.origin,
+        decoded.height,
+        decoded.round,
+        decoded.sent_us,
+        decoded.span_id,
+    ) == ("node-ab12", 7, 2, 123_456_789, 42)
+
+
+def test_traced_framing_roundtrip_and_signature_neutrality():
+    message = IbftMessage(
+        view=View(height=7, round=2), sender=b"s" * 20, signature=b"x" * 65
+    )
+    before = message.payload_no_sig()
+    ctx = TraceContext(origin="node-1", height=7, round=2, sent_us=1, span_id=2)
+    payload = encode_traced(message.encode(), ctx)
+    raw, decoded_ctx = decode_traced(payload)
+    assert decoded_ctx is not None and decoded_ctx.origin == "node-1"
+    decoded = IbftMessage.decode(raw)
+    # The signed bytes are byte-identical traced or not: the context is
+    # strictly a framing layer.
+    assert decoded.payload_no_sig() == before
+    assert decoded.signature == message.signature
+
+
+def test_bare_payload_passes_through_and_malformed_frame_degrades():
+    message = IbftMessage(view=View(height=1), sender=b"s" * 20)
+    raw, ctx = decode_traced(message.encode())
+    assert ctx is None and raw == message.encode()
+    # A frame whose context bytes are garbage must not raise: telemetry
+    # can never affect delivery.
+    raw, ctx = decode_traced(b"\xd7TCX\xff\xff\xff")
+    assert ctx is None
+
+
+def test_no_valid_message_encoding_collides_with_the_magic():
+    # The magic's first byte decodes as wire type 7, which protobuf does
+    # not define — IbftMessage.decode must reject it, so framing detection
+    # can never misclassify.
+    with pytest.raises(ValueError):
+        IbftMessage.decode(b"\xd7TCX")
+
+
+# ---------------------------------------------------------------------------
+# clock offsets
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offsets_keep_min_delta_and_bound_origins():
+    offsets = clock.ClockOffsets(max_origins=2)
+    offsets.observe("a", sent_us=100, recv_us=150)
+    offsets.observe("a", sent_us=200, recv_us=230)  # tighter: 30
+    offsets.observe("a", sent_us=300, recv_us=390)
+    assert offsets.estimate("a") == 30
+    offsets.observe("b", 0, 5)
+    offsets.observe("c", 0, 5)  # over the bound: dropped
+    assert offsets.estimate("c") is None
+    snap = offsets.snapshot()
+    assert snap["a"] == {"offset_us": 30, "samples": 3}
+
+
+# ---------------------------------------------------------------------------
+# deterministic reconstruction (the acceptance-criterion pin)
+# ---------------------------------------------------------------------------
+
+A, B, C, D = "node-A", "node-B", "node-C", "node-D"
+
+
+def _doc(events, node=None, offsets=None, dropped=0):
+    tids = {}
+    rendered = []
+    for name, track, ts, dur, args, ph in events:
+        tid = tids.get(track)
+        if tid is None:
+            tid = tids[track] = len(tids)
+            rendered.append(
+                {
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": track},
+                }
+            )
+        e = {
+            "ph": ph,
+            "pid": 0,
+            "tid": tid,
+            "name": name,
+            "cat": "obs",
+            "ts": ts,
+            "args": args,
+        }
+        if ph == "X":
+            e["dur"] = dur
+        rendered.append(e)
+    other = {"droppedRecords": dropped}
+    if node is not None:
+        other["node"] = node
+    if offsets is not None:
+        other["clockOffsetsUs"] = offsets
+    return {"displayTimeUnit": "ms", "otherData": other, "traceEvents": rendered}
+
+
+def _seeded_schedule():
+    """A deterministic 4-node height-1 schedule (all timestamps µs)."""
+    ev = []
+
+    def send(track, ts, mtype, span):
+        ev.append(
+            ("net.send", track, ts, 0, {"height": 1, "round": 0, "type": mtype, "span": span}, "i")
+        )
+
+    def recv(track, ts, origin, mtype, span, sent):
+        ev.append(
+            (
+                "net.recv",
+                track,
+                ts,
+                0,
+                {
+                    "origin": origin,
+                    "height": 1,
+                    "round": 0,
+                    "type": mtype,
+                    "span": span,
+                    "sent_us": sent,
+                },
+                "i",
+            )
+        )
+
+    # Proposal broadcast from A at t=1000.
+    send(A, 1000, 0, 1)
+    for track, ts in ((A, 1000), (B, 1200), (C, 1400), (D, 1600)):
+        recv(track, ts, A, 0, 1, 1000)
+    # PREPAREs from B/C/D (the proposer sends none).
+    send(B, 1300, 1, 2)
+    send(C, 1500, 1, 3)
+    send(D, 1700, 1, 4)
+    # Arrivals at D: self 1700, B 1800, C 1900 -> quorum(3) at 1900 by C.
+    recv(D, 1700, D, 1, 4, 1700)
+    recv(D, 1800, B, 1, 2, 1300)
+    recv(D, 1900, C, 1, 3, 1500)
+    # A duplicate delivery AFTER quorum must not shift it.
+    recv(D, 2600, B, 1, 2, 1300)
+    # COMMITs from everyone.
+    for track, ts, span in ((A, 2000, 5), (B, 2100, 6), (C, 2200, 7), (D, 2300, 8)):
+        send(track, ts, 2, span)
+    # Arrivals at D: self 2300, A 2400, B 2500 -> quorum at 2500 by B.
+    recv(D, 2300, D, 2, 8, 2300)
+    recv(D, 2400, A, 2, 5, 2000)
+    recv(D, 2500, B, 2, 6, 2100)
+    # Height windows + finalize order: D is last (the critical node).
+    for track, ts in ((A, 900), (B, 950), (C, 960), (D, 970)):
+        ev.append(("sequence.start", track, ts, 0, {"height": 1}, "i"))
+    for track, ts in ((A, 2700), (B, 2800), (C, 2900), (D, 3000)):
+        ev.append(("sequence.done", track, ts, 0, {"height": 1}, "i"))
+    # Verification work on D after COMMIT quorum: 100µs.
+    ev.append(("verify.drain", D, 2550, 100, {"route": "host"}, "X"))
+    # Phase drain on D before quorum (counted as drain, not wakeup).
+    ev.append(("prepare.drain", D, 1950, 40, {}, "X"))
+    return ev
+
+
+def test_reconstruct_pins_critical_path_on_seeded_schedule(tmp_path):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_doc(_seeded_schedule())))
+    trace_file = timeline.load_trace_file(str(path))
+    (tl,) = timeline.reconstruct(timeline.merge_events([trace_file]))
+    assert tl.height == 1
+    assert tl.proposer == A
+    assert tl.proposal_sent == 1000
+    crit = tl.critical_node
+    assert crit is not None and crit.node == D
+    # Quorum completion: the 3rd DISTINCT origin, duplicates ignored.
+    assert (crit.prepare_quorum_at, crit.prepare_completer) == (1900, C)
+    assert (crit.commit_quorum_at, crit.commit_completer) == (2500, B)
+    split = tl.to_dict()["critical_path"]
+    assert split["proposal_broadcast_us"] == 600
+    assert split["prepare_wait_us"] == 300
+    assert split["commit_wait_us"] == 600
+    assert split["finalize_tail_us"] == 500
+    assert split["verify_us"] == 100
+    assert split["drain_us"] == 40
+    # Wakeup = finalize tail minus busy spans after commit quorum.
+    assert split["wakeup_us"] == 400
+    assert split["total_us"] == 2000
+    report = timeline.render_report([tl])
+    assert "critical node     node-D" in report
+    assert "completed by node-C" in report
+
+
+def test_default_quorum_matches_optimal_bft():
+    assert timeline.default_quorum(4) == 3
+    assert timeline.default_quorum(7) == 5
+    assert timeline.default_quorum(100) == 67
+
+
+def test_cross_file_clock_alignment(tmp_path):
+    # File A (reference): its raw clock. One self send/recv pair anchors
+    # the export rebase (raw 1_000_000 exported at ts 0).
+    a_events = [
+        ("net.send", A, 0, 0, {"height": 1, "round": 0, "type": 2, "span": 1}, "i"),
+        (
+            "net.recv",
+            A,
+            5,
+            0,
+            {"origin": A, "height": 1, "round": 0, "type": 2, "span": 1, "sent_us": 1_000_000},
+            "i",
+        ),
+    ]
+    # File B: raw clock runs 4_000_000µs AHEAD of A's.  Its send at raw
+    # 5_000_000 (= A-raw 1_000_000) exports at ts 0.
+    b_events = [
+        ("net.send", B, 0, 0, {"height": 1, "round": 0, "type": 2, "span": 9}, "i"),
+        (
+            "net.recv",
+            B,
+            10,
+            0,
+            {"origin": B, "height": 1, "round": 0, "type": 2, "span": 9, "sent_us": 5_000_000},
+            "i",
+        ),
+    ]
+    # A measured B's offset: recv_A_raw - sent_B_raw = -4_000_000 + 50µs
+    # min one-way delay.
+    (tmp_path / "a.json").write_text(
+        json.dumps(
+            _doc(a_events, node=A, offsets={B: {"offset_us": -3_999_950, "samples": 3}})
+        )
+    )
+    (tmp_path / "b.json").write_text(json.dumps(_doc(b_events, node=B)))
+    files = [
+        timeline.load_trace_file(str(tmp_path / "a.json")),
+        timeline.load_trace_file(str(tmp_path / "b.json")),
+    ]
+    merged = timeline.merge_events(files)
+    b_send = next(
+        e for e in merged if e.name == "net.send" and e.args.get("span") == 9
+    )
+    # B's ts 0 is raw 5_000_000 = A-raw 1_000_050 (est includes the 50µs
+    # delay) = A-export ts 50.
+    assert b_send.ts == 50
+
+
+def test_to_perfetto_groups_files_as_processes(tmp_path):
+    (tmp_path / "a.json").write_text(json.dumps(_doc(_seeded_schedule(), node=A)))
+    files = [timeline.load_trace_file(str(tmp_path / "a.json"))]
+    doc = timeline.to_perfetto(files)
+    names = {
+        e["name"] for e in doc["traceEvents"] if e["ph"] == "M"
+    }
+    assert {"process_name", "thread_name"} <= names
+    assert doc["otherData"]["droppedRecords"] == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end: a real cluster's trace reconstructs
+# ---------------------------------------------------------------------------
+
+
+async def test_live_cluster_trace_reconstructs_every_height(tmp_path):
+    from tests.harness import Cluster
+
+    rec = trace.enable(1 << 16)
+    cluster = Cluster(4)
+    try:
+        for h in range(3):
+            await cluster.run_height(h, timeout=10.0)
+    finally:
+        cluster.shutdown()
+    path = tmp_path / "live.json"
+    export.write_chrome_trace(str(path), rec, node="node-merged")
+    trace_file = timeline.load_trace_file(str(path))
+    assert trace_file.node == "node-merged"
+    timelines = timeline.reconstruct(timeline.merge_events([trace_file]))
+    finalized = {tl.height for tl in timelines if tl.critical_node is not None}
+    assert finalized == {0, 1, 2}
+    for tl in timelines:
+        if tl.critical_node is None:
+            continue
+        split = tl.to_dict()["critical_path"]
+        assert split["commit_completer"] is not None
+        assert split["total_us"] is not None and split["total_us"] > 0
+        # Every leg is non-negative on the shared loopback clock.
+        for leg in (
+            "proposal_broadcast_us",
+            "prepare_wait_us",
+            "commit_wait_us",
+            "finalize_tail_us",
+        ):
+            assert split[leg] is not None and split[leg] >= 0, (leg, split)
+
+
+async def test_engine_send_recv_records_are_causally_linked():
+    from tests.harness import Cluster
+
+    rec = trace.enable(1 << 16)
+    cluster = Cluster(4)
+    try:
+        await cluster.run_height(0, timeout=10.0)
+    finally:
+        cluster.shutdown()
+    records = rec.snapshot()
+    sends = {r[5]["span"]: r for r in records if r[1] == "net.send"}
+    recvs = [r for r in records if r[1] == "net.recv"]
+    assert sends and recvs
+    for r in recvs:
+        span = r[5]["span"]
+        assert span in sends  # every recv's span id has a matching send
+        send = sends[span]
+        # The recv carries the sender's view + origin track.
+        assert r[5]["origin"] == send[2]
+        assert r[5]["height"] == send[5]["height"]
+        assert r[5]["sent_us"] <= r[3]  # recv never precedes its send
+    # Loopback: every node received every send (self-delivery included).
+    tracks = {r[2] for r in recvs}
+    assert len(tracks) == 4

@@ -3,7 +3,7 @@
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
-	serve-bench timeline-smoke slo-gates
+	serve-bench timeline-smoke slo-gates multipair-bench
 
 test:
 	python -m pytest tests/ -q
@@ -65,6 +65,20 @@ latency-smoke:
 serve-bench:
 	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=600 \
 	python bench.py --serve-only
+
+# Batched multi-pairing (config #13): N-cert batched certificate verify
+# (ONE dispatch, oracle-gated against the per-cert loop incl. seeded
+# corrupt certs) vs sequential aggregate_check, plus the
+# 100/300/1000-validator committee sweep.  GO_IBFT_MULTIPAIR_BENCH=1
+# additionally runs the vmapped g2 merge-tree KERNEL on forced host
+# devices (the mesh-bench posture: exercise the real device route
+# without TPU hardware; the merge program is small, unlike the pairing).
+# GO_IBFT_MULTIPAIR_CERTS / GO_IBFT_MULTIPAIR_SIZES scale the run.
+multipair-bench:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	GO_IBFT_MULTIPAIR_BENCH=1 GO_IBFT_BENCH_BUDGET_S=900 \
+	python bench.py --multipair-only
 
 # Multi-tenant fairness soak: hot + slow chains sharing one scheduler
 # under seeded chaos (tests/test_sched_consensus.py, slow tier included)

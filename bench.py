@@ -2342,6 +2342,193 @@ def _config12_qos_phase(sched, flood_blocks, flood_src) -> dict:
     }
 
 
+def config13_multipair() -> None:
+    """Batched multi-pairing certificate verification (config #13, ISSUE 12).
+
+    N aggregate quorum certificates verify through ONE batched
+    ``multi_aggregate_check`` dispatch (``BLSCertifier.verify_many``)
+    against the sequential per-cert ``aggregate_check`` loop — the route
+    every consumer ran before this PR (one pairing dispatch per height).
+    On the CPU fallback the batched route is the host small-exponents
+    batch (2N fast Millers + per-lane 64-bit exponents + ONE shared
+    final exponentiation, the ~90% term of a host pairing); on a live
+    chip it is the staged batched device kernel.  Verdicts are
+    oracle-gated BEFORE timing on a seeded corrupt set (a relabeled
+    certificate and a bit-flipped aggregate seal) — batched verdicts
+    must match per-cert ``verify`` bit-for-bit.
+
+    The committee-size sweep measures the host aggregation + one-pairing
+    check at 100/300/1000 validators — the host-route line config #9's
+    chip-blocked ``device_sizes`` never produced — and, under
+    ``GO_IBFT_MULTIPAIR_BENCH=1`` (the `make multipair-bench` forced-host
+    mode), the vmapped g2 merge-tree kernel route at the same sizes with
+    the merged point pinned to the host loop's.
+    """
+    from go_ibft_tpu.bench.bls_workload import _bls_keys
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.crypto import bls as hbls
+    from go_ibft_tpu.crypto.quorum_cert import (
+        AggregateQuorumCertificate,
+        BLSCertifier,
+    )
+    from go_ibft_tpu.messages.helpers import CommittedSeal
+    from go_ibft_tpu.utils import metrics as umetrics
+    from go_ibft_tpu.verify.aggregate import (
+        MULTIPAIR_DISPATCHES_KEY,
+        G2MergeTree,
+    )
+    from go_ibft_tpu.verify.bls import aggregate_check, encode_seal
+
+    # Floor of 2: the corrupt-verdict gate needs a relabeled AND a
+    # bit-flipped certificate (GO_IBFT_MULTIPAIR_CERTS=1 would otherwise
+    # die on an IndexError before any evidence line).
+    n_certs = max(
+        2,
+        int(
+            os.environ.get(
+                "GO_IBFT_MULTIPAIR_CERTS", "12" if _FALLBACK else "1000"
+            )
+        ),
+    )
+    committee = 4  # small committee: the config measures PAIRING batching
+    quorum = (2 * committee) // 3 + 1
+    eck = _keys(committee, 13)
+    blk = _bls_keys(committee, 13)
+    powers = {k.address: 1 for k in eck}
+    keys = {e.address: b.pubkey for e, b in zip(eck, blk)}
+    certifier = BLSCertifier(
+        lambda _h: powers, lambda _h: keys, device=not _FALLBACK
+    )
+    route = "device" if not _FALLBACK else "host-batch (shared final exp)"
+
+    def build_cert(height: int) -> AggregateQuorumCertificate:
+        phash = (b"mp bench h%d" % height + b"\x00" * 32)[:32]
+        seals = [
+            CommittedSeal(e.address, encode_seal(b.sign(phash)))
+            for e, b in zip(eck[:quorum], blk[:quorum])
+        ]
+        cert = certifier.build(height, 0, phash, seals)
+        assert cert is not None
+        return cert
+
+    certs = [build_cert(h) for h in range(1, n_certs + 1)]
+
+    # -- oracle gate (before any timing): batched == per-cert verify ----
+    gate = list(certs[: min(6, n_certs)])
+    relabeled = AggregateQuorumCertificate.decode(gate[0].encode())
+    relabeled.proposal_hash = b"\x66" * 32  # structural/pairing mismatch
+    flipped_seal = bytearray(gate[1].agg_seal)
+    flipped_seal[7] ^= 0x10
+    flipped = AggregateQuorumCertificate.decode(gate[1].encode())
+    flipped.agg_seal = bytes(flipped_seal)
+    gate = [relabeled, flipped] + gate[2:]
+    expected = np.asarray([certifier.verify(c) for c in gate])
+    got = np.asarray(certifier.verify_many(gate))
+    assert (got == expected).all(), (
+        "batched multi-pairing verdicts diverged from the per-cert "
+        f"oracle: {got.tolist()} vs {expected.tolist()}"
+    )
+    assert not expected[0] and not expected[1]  # the corruptions bite
+
+    # -- timed: sequential per-cert loop vs ONE batched dispatch --------
+    t0 = time.perf_counter()
+    seq_mask = [certifier.verify(c) for c in certs]
+    sequential_ms = (time.perf_counter() - t0) * 1e3
+    assert all(seq_mask)
+    d0 = umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY)
+    t0 = time.perf_counter()
+    bat_mask = np.asarray(certifier.verify_many(certs))
+    batched_ms = (time.perf_counter() - t0) * 1e3
+    dispatches = umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY) - d0
+    assert bat_mask.all()
+    assert dispatches == 1, (
+        f"{n_certs} certificates took {dispatches} multi-pairing "
+        "dispatches — the batch contract is ONE"
+    )
+    ratio = sequential_ms / batched_ms
+    if n_certs >= 8:
+        # The acceptance floor; below 8 lanes the shared final exp has
+        # too little to amortize for the bound to be meaningful.
+        assert ratio >= 5.0, (
+            f"batched multi-pairing only {ratio:.2f}x sequential at "
+            f"{n_certs} certs (acceptance >= 5x)"
+        )
+
+    # -- committee-size sweep: the host-route line for config #9's
+    # chip-blocked device_sizes (aggregation cost scales with committee,
+    # the pairing does not), plus the merge-tree kernel route in
+    # forced-host mode.
+    sweep_env = os.environ.get("GO_IBFT_MULTIPAIR_SIZES", "100,300,1000")
+    sizes = [int(s) for s in sweep_env.split(",") if s]
+    tree_mode = os.environ.get("GO_IBFT_MULTIPAIR_BENCH") == "1"
+    merger = G2MergeTree(device=True) if tree_mode or not _FALLBACK else None
+    committee_sizes = {}
+    skipped_sizes = []
+    # Rough per-size cost: signing dominates (~8 ms/seal host).
+    for size in sizes:
+        need_s = 5.0 + size * 0.012 * (2 if merger is not None else 1)
+        if _remaining_s() < 40.0 + need_s:
+            skipped_sizes.append(size)
+            committee_sizes[str(size)] = {"note": "skipped: budget"}
+            continue
+        skeys = _bls_keys(size, 13)
+        msg = (b"mp sweep %d" % size + b"\x00" * 32)[:32]
+        sigs = [k.sign(msg) for k in skeys]
+        pks = [k.pubkey for k in skeys]
+        t0 = time.perf_counter()
+        agg = hbls.aggregate_signatures(sigs)
+        host_agg_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        assert aggregate_check(msg, [agg], pks)
+        check_ms = (time.perf_counter() - t0) * 1e3
+        entry = {
+            "host_agg_ms": round(host_agg_ms, 3),
+            "check_ms": round(check_ms, 3),
+        }
+        if merger is not None:
+            tree_agg = merger.merge(sigs)  # warm (compile outside timer)
+            assert tree_agg == agg, (
+                f"{size}v merge-tree aggregate diverged from the host loop"
+            )
+            t0 = time.perf_counter()
+            merger.merge(sigs)
+            entry["tree_agg_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            entry["tree_route"] = (
+                "xla:cpu forced-host" if _FALLBACK else "device"
+            )
+        committee_sizes[str(size)] = entry
+
+    line = {
+        "metric": config13_multipair.metric,
+        "value": round(batched_ms, 3),
+        "unit": "ms (host route)" if _FALLBACK else "ms",
+        "vs_baseline": round(ratio, 2),
+        "baseline": f"sequential per-cert aggregate_check loop ({n_certs} certs)",
+        "ratio": round(ratio, 2),
+        "certs": n_certs,
+        "sequential_ms": round(sequential_ms, 3),
+        "batched_ms": round(batched_ms, 3),
+        "dispatches": int(dispatches),
+        "lanes_per_dispatch": n_certs,
+        "route": route,
+        "oracle_exact": True,
+        "corrupt_gate": {"corrupted": 2, "oracle_exact": True},
+        "committee_sizes": committee_sizes,
+        "skipped_sizes": skipped_sizes,
+    }
+    if _FALLBACK:
+        line["variant"] = (
+            f"host-routed ({n_certs} certs, CPU fallback; batched = "
+            "small-exponents batch on the host tower — one shared final "
+            "exponentiation; device route is chip-blocked)"
+        )
+        if merger is not None and merger.stats()["device_merges"]:
+            line["variant"] += "; merge-tree kernel on forced-host XLA:CPU"
+    _log(line)
+
+
 def config2_host_fallback() -> None:
     """Config #2 CPU-fallback variant: whole-round verify on the host route.
 
@@ -2591,6 +2778,7 @@ config9_aggregate.metric = "aggregate_commit_cert_100v"
 config10_multitenant.metric = "multi_tenant_blocks_per_s"
 config11_commit_critical_path.metric = "commit_critical_path_100v"
 config12_proof_serving.metric = "proof_serving_100v"
+config13_multipair.metric = "batched_multipairing_1000c"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -2607,31 +2795,33 @@ config2_host_fallback.metric = headline_metric(True)
 # and must stay the final parsed line); the headline runs last on a live
 # chip (guarded separately in _run).
 _FALLBACK_SCHEDULE = (
-    (config3_host_scaled, 300.0),
-    (config4_host_scaled, 250.0),
-    (config5_host_scaled, 220.0),
-    (config6_chaos, 195.0),
-    (config7_chain, 155.0),
-    (config8_mesh, 145.0),
-    (config9_aggregate, 115.0),
-    (config10_multitenant, 75.0),
-    (config11_commit_critical_path, 65.0),
-    (config12_proof_serving, 35.0),
+    (config3_host_scaled, 330.0),
+    (config4_host_scaled, 280.0),
+    (config5_host_scaled, 250.0),
+    (config6_chaos, 225.0),
+    (config7_chain, 185.0),
+    (config8_mesh, 175.0),
+    (config9_aggregate, 145.0),
+    (config10_multitenant, 105.0),
+    (config11_commit_critical_path, 95.0),
+    (config12_proof_serving, 65.0),
+    (config13_multipair, 35.0),
     (config2_host_fallback, 30.0),
     (config1_happy_path, 0.0),
 )
 _DEVICE_SCHEDULE = (
-    (config1_happy_path, 600.0),
-    (config3_pipelined, 540.0),
-    (config4_bls, 480.0),
-    (config5_byzantine_mix, 440.0),
-    (config6_chaos, 420.0),
-    (config7_chain, 400.0),
-    (config8_mesh, 390.0),
-    (config9_aggregate, 370.0),
-    (config10_multitenant, 340.0),
-    (config11_commit_critical_path, 330.0),
-    (config12_proof_serving, 300.0),
+    (config1_happy_path, 620.0),
+    (config3_pipelined, 560.0),
+    (config4_bls, 500.0),
+    (config5_byzantine_mix, 460.0),
+    (config6_chaos, 440.0),
+    (config7_chain, 420.0),
+    (config8_mesh, 410.0),
+    (config9_aggregate, 390.0),
+    (config10_multitenant, 360.0),
+    (config11_commit_critical_path, 350.0),
+    (config12_proof_serving, 320.0),
+    (config13_multipair, 300.0),
 )
 
 
@@ -2702,6 +2892,17 @@ def main(argv=None) -> None:
         help="run ONLY the commit-critical-path config (#11); the rc=0 "
         "evidence contract scopes to it (the `make latency-smoke` entry "
         "point — speculation + early-exit on vs off on the host route)",
+    )
+    parser.add_argument(
+        "--multipair-only",
+        action="store_true",
+        help="run ONLY the batched multi-pairing config (#13); the rc=0 "
+        "evidence contract scopes to it (the `make multipair-bench` entry "
+        "point — N-cert batched verify vs the sequential aggregate_check "
+        "loop plus the 100/300/1000-validator committee sweep; "
+        "GO_IBFT_MULTIPAIR_CERTS / GO_IBFT_MULTIPAIR_SIZES scale it, "
+        "GO_IBFT_MULTIPAIR_BENCH=1 adds the forced-host merge-tree "
+        "kernel route)",
     )
     parser.add_argument(
         "--serve-only",
@@ -2814,6 +3015,20 @@ def _run(args) -> None:
         failures = []
         _guarded(config12_proof_serving, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config12_proof_serving.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.multipair_only:
+        # Scoped run for `make multipair-bench`: only config #13, rc=0
+        # iff its evidence line landed.  The config oracle-gates the
+        # batched verdicts against the per-cert oracle (seeded corrupt
+        # certificates included) before timing anything.
+        failures = []
+        _guarded(config13_multipair, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config13_multipair.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

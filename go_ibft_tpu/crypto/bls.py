@@ -472,8 +472,16 @@ def _line(p1: Point12, p2: Point12, t: Point12) -> Fp12T:
     return _f12_sub(f12_mul(m, _f12_sub(xt, x1)), _f12_sub(yt, y1))
 
 
-def pairing(q: PointG2, p: PointG1) -> Fp12T:
-    """Reduced ate pairing e(q, p); bilinear, non-degenerate on the r-torsion."""
+def miller_raw(q: PointG2, p: PointG1) -> Fp12T:
+    """The UNREDUCED ate Miller value f_{|x|,q}(p) (pre-inversion, pre-
+    final-exponentiation).
+
+    Exposed so batch verification can combine many pairings' Miller
+    values and pay ONE final exponentiation for the whole product
+    (:mod:`go_ibft_tpu.verify.aggregate`) — the final exponentiation is
+    ~90% of a host pairing's cost.  ``pairing`` is exactly
+    ``f12_pow(f12_inv(miller_raw(q, p)), (p^12 - 1) / r)``.
+    """
     if q is None or p is None:
         return F12_ONE
     q12 = _untwist(q)
@@ -486,9 +494,21 @@ def pairing(q: PointG2, p: PointG1) -> Fp12T:
         if bit == "1":
             f = f12_mul(f, _line(acc, q12, p12))
             acc = _p12_add(acc, q12)
+    return f
+
+
+def pairing(q: PointG2, p: PointG1) -> Fp12T:
+    """Reduced ate pairing e(q, p); bilinear, non-degenerate on the r-torsion."""
+    if q is None or p is None:
+        return F12_ONE
     # the BLS12-381 parameter is negative: f_{-n} = 1/f_n up to verticals
     # (killed by the final exponentiation)
-    f = f12_inv(f)
+    f = f12_inv(miller_raw(q, p))
+    return f12_pow(f, _FE_EXP)
+
+
+def final_exponentiation(f: Fp12T) -> Fp12T:
+    """``f^((p^12 - 1) / r)`` — the batch-verification finish step."""
     return f12_pow(f, _FE_EXP)
 
 

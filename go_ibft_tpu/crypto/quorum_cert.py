@@ -17,7 +17,9 @@ Three consumers share it end to end (ISSUE 7):
 * the WAL (:mod:`go_ibft_tpu.chain.wal`) persists the certificate instead
   of N seals — finalize records stop scaling with committee size;
 * block-sync (:mod:`go_ibft_tpu.chain.sync`) re-verifies a fetched range
-  with one pairing per height instead of N seal lanes per height.
+  with one certificate equation per height instead of N seal lanes —
+  batched: the whole range's equations verify as ONE multi-pairing
+  dispatch through :meth:`BLSCertifier.verify_many` (ISSUE 12).
 
 Rogue-key safety: aggregation is only sound over public keys whose
 holders have proven possession of the secret scalar (a registered
@@ -195,10 +197,25 @@ class BLSCertifier:
         bls_keys_for_height: Callable[[int], Mapping[bytes, "hbls.PointG1"]],
         *,
         device: bool = False,
+        multipair=None,
+        aggregator=None,
     ) -> None:
         self._validators = validators_for_height
         self._keys = bls_keys_for_height
         self._device = device
+        # Batched verification route (ISSUE 12): ``multipair`` is a
+        # :class:`~go_ibft_tpu.verify.aggregate.MultiPairVerifier` (or
+        # anything with ``check(lanes)``); :meth:`verify_many` routes a
+        # whole certificate batch through ONE batched dispatch.  Default:
+        # the functional ``multi_aggregate_check`` on the device or
+        # host-batch route per ``device``.
+        self._multipair = multipair
+        # ``aggregator`` is a :class:`~go_ibft_tpu.verify.aggregate.
+        # G2MergeTree` (or anything with ``merge(points)``): ``build``'s
+        # seal aggregation then rides the vmapped device merge tree
+        # instead of the sequential host g2_add loop (bit-identical
+        # results; the host loop is the oracle and the default).
+        self._aggregator = aggregator
 
     # -- build -----------------------------------------------------------
 
@@ -219,7 +236,7 @@ class BLSCertifier:
         synthetic aggregate seal (already a certificate).
         """
         members = self._validators(height)
-        agg: "hbls.PointG2" = None
+        points: List["hbls.PointG2"] = []
         signers: List[bytes] = []
         for seal in seals:
             if seal.signer == AGG_CERT_SIGNER:
@@ -229,8 +246,16 @@ class BLSCertifier:
             pt = decode_seal(seal.signature)
             if pt is None:
                 continue
-            agg = hbls.g2_add(agg, pt)
+            points.append(pt)
             signers.append(seal.signer)
+        if not points:
+            return None
+        if self._aggregator is not None:
+            # Device merge tree: one dispatch folds the whole quorum
+            # (log-depth) instead of len(points) sequential host adds.
+            agg = self._aggregator.merge(points)
+        else:
+            agg = hbls.aggregate_signatures(points)
         if agg is None:
             return None
         return self.build_from_aggregate(
@@ -310,39 +335,87 @@ class BLSCertifier:
 
     # -- verify ----------------------------------------------------------
 
-    def verify(self, cert: AggregateQuorumCertificate) -> bool:
-        """ONE pairing equation + exact-int quorum power over the bitmap.
+    def _lane_of(self, cert: AggregateQuorumCertificate):
+        """The certificate's pairing lane ``(proposal_hash, [point],
+        pubkeys)`` after every cheap check, or None when a structural
+        check already condemns it (no pairing needed).
 
         Checks, in cost order: structural sanity, bitmap-resolved signers
         exist in BOTH the power map and the PoP-gated key registry,
-        combined voting power reaches the height's quorum, the aggregated
-        point is a valid r-torsion G2 element, and finally the pairing.
+        combined voting power reaches the height's quorum, and the
+        aggregated point is a valid r-torsion G2 element.
         """
         if len(cert.proposal_hash) != 32:
-            return False
+            return None
         powers = self._validators(cert.height)
         if not powers:
-            return False
+            return None
         ordered = sorted(powers)
         try:
             signers = cert.signers(ordered)
         except ValueError:
-            return False
+            return None
         if not signers:
-            return False
+            return None
         quorum = calculate_quorum(sum(powers.values()))
         if sum(powers[a] for a in signers) < quorum:
-            return False
+            return None
         keys = self._keys(cert.height)
         pubkeys = []
         for addr in signers:
             pk = keys.get(addr)
             if pk is None:
-                return False
+                return None
             pubkeys.append(pk)
         point = decode_seal(cert.agg_seal)
         if point is None:
+            return None
+        return cert.proposal_hash, [point], pubkeys
+
+    def verify(self, cert: AggregateQuorumCertificate) -> bool:
+        """ONE pairing equation + exact-int quorum power over the bitmap
+        (see :meth:`_lane_of` for the pre-pairing check order)."""
+        lane = self._lane_of(cert)
+        if lane is None:
             return False
+        phash, points, pubkeys = lane
         return aggregate_check(
-            cert.proposal_hash, [point], pubkeys, device=self._device
+            phash, points, pubkeys, device=self._device
         )
+
+    def verify_many(self, certs: Sequence[AggregateQuorumCertificate]):
+        """MANY certificates through ONE batched multi-pairing dispatch.
+
+        Per-cert verdicts (numpy bool array) bit-identical to
+        :meth:`verify` lane-for-lane: structurally-condemned certificates
+        are False without costing any pairing work, the survivors verify
+        together through the injected
+        :class:`~go_ibft_tpu.verify.aggregate.MultiPairVerifier` (or the
+        functional batch entry on the device/host route per the
+        certifier's ``device`` flag).  This is the block-sync / proof-
+        serving seam: a 1000-height certificate range is one call here,
+        one batched dispatch below (ISSUE 12 acceptance).
+        """
+        import numpy as np
+
+        from ..verify.aggregate import multi_aggregate_check
+
+        out = np.zeros(len(certs), dtype=bool)
+        lanes = []
+        idx = []
+        for i, cert in enumerate(certs):
+            lane = self._lane_of(cert)
+            if lane is None:
+                continue
+            lanes.append(lane)
+            idx.append(i)
+        if not lanes:
+            return out
+        if self._multipair is not None:
+            mask = self._multipair.check(lanes)
+        else:
+            mask = multi_aggregate_check(
+                lanes, route="device" if self._device else "host"
+            )
+        out[np.asarray(idx)] = np.asarray(mask, dtype=bool)
+        return out

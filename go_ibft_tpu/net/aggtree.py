@@ -124,6 +124,7 @@ class AggregationTreeGossip:
         fan_in: int = 2,
         step_interval: float = 0.002,
         auto_pump: bool = True,
+        merger=None,
         logger=None,
     ) -> None:
         if fan_in < 1:
@@ -131,6 +132,13 @@ class AggregationTreeGossip:
         self.certifier = certifier
         self.fan_in = fan_in
         self.step_interval = step_interval
+        # Optional batched merge seam (ISSUE 12): a
+        # :class:`~go_ibft_tpu.verify.aggregate.G2MergeTree` (anything
+        # with ``merge_groups``) turns each sweep LEVEL's slot merges
+        # into ONE vmapped combine — O(depth) dispatches per sweep
+        # instead of per-node-per-key Python g2_adds.  None keeps the
+        # host fold (bit-identical; the small-committee default).
+        self.merger = merger
         # auto_pump: sweep inline after each ingest while no cadence task
         # runs (synchronous callers converge without an event loop).
         # False = strictly periodic/manual pumping — the batched mode.
@@ -163,6 +171,8 @@ class AggregationTreeGossip:
         self.rejected_partials = 0
         self.certs_built = 0
         self._task = None
+        # (node count, depth -> indices) — see _levels().
+        self._levels_cache = None
 
     # -- wiring ----------------------------------------------------------
 
@@ -265,13 +275,24 @@ class AggregationTreeGossip:
 
     # -- tree mechanics ---------------------------------------------------
 
-    def _merged(self, i: int, key: tuple):
-        slots = self._nodes[i].slots.get(key, {})
-        point = None
+    @staticmethod
+    def _slot_parts(slots) -> Tuple[List[object], FrozenSet[bytes]]:
+        """One key's slot dict -> (points, merged signer set) — the ONE
+        fold shape shared by _merged, the pump's level walk, and the
+        root candidate block (so slot semantics can never diverge
+        between them)."""
+        points: List[object] = []
         signers: FrozenSet[bytes] = frozenset()
         for p, s in slots.values():
-            point = hbls.g2_add(point, p)
+            points.append(p)
             signers = signers | s
+        return points, signers
+
+    def _merged(self, i: int, key: tuple):
+        points, signers = self._slot_parts(self._nodes[i].slots.get(key, {}))
+        point = None
+        for p in points:
+            point = hbls.g2_add(point, p)
         return point, signers
 
     def _set_slot(self, i: int, key: tuple, slot, point, signers) -> None:
@@ -287,65 +308,128 @@ class AggregationTreeGossip:
         slots[slot] = (point, signers)
         node.dirty.add(key)
 
+    def _depth_of(self, i: int) -> int:
+        d = 0
+        while i > 0:
+            i = (i - 1) // self.fan_in
+            d += 1
+        return d
+
+    def _levels(self) -> Dict[int, List[int]]:
+        """depth -> node indices (root excluded), cached: the topology is
+        fixed by registration order, and pump() runs inline after every
+        ingest — rebuilding the grouping under the hub lock per COMMIT
+        would be O(N log N) of pure overhead (callers hold the lock)."""
+        cached = self._levels_cache
+        if cached is not None and cached[0] == len(self._nodes):
+            return cached[1]
+        by_depth: Dict[int, List[int]] = {}
+        for i in range(1, len(self._nodes)):
+            by_depth.setdefault(self._depth_of(i), []).append(i)
+        self._levels_cache = (len(self._nodes), by_depth)
+        return by_depth
+
+    def _merge_level(self, work: List[tuple]) -> List[object]:
+        """Merge each work item's slot points — one vmapped combine for
+        the whole level through :attr:`merger`, or the host fold."""
+        groups = [pts for _i, _key, _signers, pts in work]
+        if self.merger is not None:
+            return self.merger.merge_groups(groups)
+        out = []
+        for pts in groups:
+            point = None
+            for p in pts:
+                point = hbls.g2_add(point, p)
+            out.append(point)
+        return out
+
+    def _send_up(self, i: int, key: tuple, merged_point, merged_signers):
+        """Push one merged partial to node ``i``'s parent (lock held).
+
+        One certificate-shaped partial up the tree: the 192-byte merged
+        point + signer bitmap — size independent of how many seals the
+        subtree merged (the bitmap's 1 bit/validator is the only
+        N-term).  A merge CAN cancel to infinity (a Byzantine seal equal
+        to a sibling's negation — the tree relays unverified); the
+        partial still travels, encoded as zeros, and the root's
+        quarantine evicts the offending leaf when certification fails.
+        """
+        node = self._nodes[i]
+        node.sent[key] = merged_signers
+        height, round_, phash = key
+        wire = AggregateQuorumCertificate(
+            height=height,
+            round=round_,
+            proposal_hash=phash,
+            agg_seal=(
+                encode_seal(merged_point)
+                if merged_point is not None
+                else b"\x00" * 192
+            ),
+            bitmap=b"\x00" * ((len(self._nodes) + 7) // 8),
+        )
+        node.commit_bytes += len(wire.encode())
+        node.commit_msgs += 1
+        metrics.inc_counter(PARTIALS_SENT_KEY)
+        self._set_slot(self._parent(i), key, i, merged_point, merged_signers)
+
     def pump(self) -> None:
         """One gossip sweep: children-first, each dirty node sends ONE
         merged partial per in-flight key to its parent; the root then
         certifies any key that reached quorum.
 
-        Children-first order makes a single sweep fully converge (a
-        partial pushed into a parent is processed later in the same
-        sweep), while capping every node's send rate at one partial per
-        key per sweep — the periodic-gossip batching that keeps per-node
-        wire cost independent of committee size.  Runs inline after every
-        ingest (cheap: nothing dirty = no-op) and from the optional
+        The walk is grouped by tree LEVEL (deepest first — same
+        children-first convergence as the node-ordered walk, since a
+        parent is always strictly shallower than its children): a single
+        sweep fully converges, every node's send rate stays capped at
+        one partial per key per sweep, and with a :attr:`merger`
+        attached every level's slot merges run as ONE vmapped device
+        combine instead of per-child Python g2_adds (ISSUE 12 — O(depth)
+        merge dispatches per sweep).  Runs inline after every ingest
+        (cheap: nothing dirty = no-op) and from the optional
         :meth:`start` cadence task.
         """
         to_deliver = []
         with self._lock:
-            for i in range(len(self._nodes) - 1, 0, -1):
-                node = self._nodes[i]
-                if not node.dirty:
-                    continue
-                parent = self._parent(i)
-                for key in sorted(node.dirty):
-                    merged_point, merged_signers = self._merged(i, key)
-                    if not (merged_signers - node.sent.get(key, frozenset())):
+            # Level membership is walked deepest-first with the DIRTY
+            # check at visit time (not snapshotted): a push from depth
+            # d+1 dirties a depth-d parent mid-sweep, and children-first
+            # convergence requires that parent to send in THIS sweep.
+            by_depth = self._levels()
+            for depth in sorted(by_depth, reverse=True):
+                work: List[tuple] = []
+                for i in by_depth[depth]:
+                    node = self._nodes[i]
+                    if not node.dirty:
                         continue
-                    node.sent[key] = merged_signers
-                    # One certificate-shaped partial up the tree: the
-                    # 192-byte merged point + signer bitmap — size
-                    # independent of how many seals the subtree merged
-                    # (the bitmap's 1 bit/validator is the only N-term).
-                    # A merge CAN cancel to infinity (a Byzantine seal
-                    # equal to a sibling's negation — the tree relays
-                    # unverified); the partial still travels, encoded as
-                    # zeros, and the root's quarantine evicts the
-                    # offending leaf when certification fails.
-                    height, round_, phash = key
-                    wire = AggregateQuorumCertificate(
-                        height=height,
-                        round=round_,
-                        proposal_hash=phash,
-                        agg_seal=(
-                            encode_seal(merged_point)
-                            if merged_point is not None
-                            else b"\x00" * 192
-                        ),
-                        bitmap=b"\x00" * ((len(self._nodes) + 7) // 8),
-                    )
-                    node.commit_bytes += len(wire.encode())
-                    node.commit_msgs += 1
-                    metrics.inc_counter(PARTIALS_SENT_KEY)
-                    self._set_slot(
-                        parent, key, i, merged_point, merged_signers
-                    )
-                node.dirty.clear()
+                    for key in sorted(node.dirty):
+                        points, signers = self._slot_parts(
+                            node.slots.get(key, {})
+                        )
+                        if not (
+                            signers - node.sent.get(key, frozenset())
+                        ):
+                            continue  # nothing new: no merge, no send
+                        work.append((i, key, signers, points))
+                    node.dirty.clear()
+                for (i, key, signers, _pts), point in zip(
+                    work, self._merge_level(work)
+                ):
+                    self._send_up(i, key, point, signers)
             root = self._nodes[0] if self._nodes else None
             candidates = []
             if root is not None and root.dirty:
+                rwork = []
                 for key in sorted(root.dirty):
-                    candidates.append((key, *self._merged(0, key)))
+                    points, signers = self._slot_parts(
+                        root.slots.get(key, {})
+                    )
+                    rwork.append((0, key, signers, points))
                 root.dirty.clear()
+                for (_i, key, signers, _pts), point in zip(
+                    rwork, self._merge_level(rwork)
+                ):
+                    candidates.append((key, point, signers))
         # Certification pairs OUTSIDE the lock (a host pairing is ~1 s;
         # holding the hub lock through it would block every node's COMMIT
         # ingest); only the unhappy-path quarantine re-acquires it.

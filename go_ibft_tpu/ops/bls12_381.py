@@ -45,12 +45,17 @@ __all__ = [
     "G2Jac",
     "g1_aggregate",
     "g2_aggregate",
+    "g1_merge_tree",
+    "g2_merge_tree",
     "miller_loop",
     "final_exp3",
     "f12_eq_one",
     "aggregate_verify_commit",
+    "multi_pairing_check",
     "pack_g1_points",
     "pack_g2_points",
+    "unpack_g1_points",
+    "unpack_g2_points",
 ]
 
 BLS_X = host.BLS_X  # |x|; the parameter is negative
@@ -394,7 +399,25 @@ _g2_double, _g2_add = _jac_ops(_Fp2Ops)
 
 
 def _tree_reduce(points, point_add, n: int):
-    """Log-depth masked sum: fold the leading (power-of-two) axis."""
+    """Log-depth masked sum folding the point axis (second-to-last array
+    axis), as ONE ``lax.scan`` over the halving levels.
+
+    The scan body holds a SINGLE point-add instance where the previous
+    unrolled form inlined ``log2(n)`` of them — at the 8-validator pin
+    that alone was three complete-add traces per group, most of the
+    aggregation stage's stablehlo (the same dedup discipline as the
+    hard-part chain's five-exp scan).  Each level ``k`` computes
+    ``points[i] + points[i + n/2^(k+1)]`` over the FULL fixed-shape axis
+    (a dynamic roll keeps the scan carrier shape-invariant); lanes at or
+    past the live half become garbage that no later level — and not the
+    final ``[..., 0, :]`` read — ever consumes, so no per-level mask is
+    needed.  Coordinates are renormed to the fixed :data:`~.bls_fp.
+    RN_BOUND` up front so the carried bounds are step-invariant.
+
+    Leading batch axes are supported: ``(..., V, L)`` limb arrays reduce
+    ``V`` groups-parallel (the multi-pairing route's per-lane pubkey
+    aggregation rides this).
+    """
     assert n and (n & (n - 1)) == 0, "pad validator axis to a power of two"
 
     def fvmap(fn, tree):
@@ -404,18 +427,48 @@ def _tree_reduce(points, point_add, n: int):
             is_leaf=lambda x: isinstance(x, FV),
         )
 
-    while n > 1:
-        n //= 2
-        half = n
-        lo = fvmap(lambda a: a[:half], points)
-        hi = fvmap(lambda a: a[half:], points)
-        points = point_add(lo, hi)
-    return fvmap(lambda a: a[0], points)
+    points = jax.tree_util.tree_map(
+        fp.renorm_to, points, is_leaf=lambda x: isinstance(x, FV)
+    )
+    if n == 1:
+        return fvmap(lambda a: a[..., 0, :], points)
+
+    def arrs(tree):
+        return [
+            v.arr
+            for v in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, FV)
+            )
+        ]
+
+    def rebuild(raw):
+        rebuilt = [FV(a, RN_BOUND) for a in raw]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(
+                points, is_leaf=lambda x: isinstance(x, FV)
+            ),
+            rebuilt,
+        )
+
+    halves = jnp.asarray(
+        [n >> (k + 1) for k in range(n.bit_length() - 1)], dtype=jnp.int32
+    )
+
+    def body(carry, half):
+        pts = rebuild(carry)
+        shifted = fvmap(lambda a: jnp.roll(a, -half, axis=-2), pts)
+        return arrs(point_add(pts, shifted)), None
+
+    out, _ = jax.lax.scan(body, arrs(points), halves)
+    return fvmap(lambda a: a[..., 0, :], rebuild(out))
 
 
 def g1_aggregate(xs: FV, ys: FV, live) -> G1Jac:
-    """Masked sum of affine G1 points over the leading axis (power of 2)."""
-    n = xs.arr.shape[0]
+    """Masked sum of affine G1 points over the point axis (power of 2).
+
+    ``xs``/``ys`` are ``(..., V, L)`` limb arrays, ``live`` is ``(..., V)``;
+    leading axes batch independent groups through one shared tree."""
+    n = xs.arr.shape[-2]
     one = FV(jnp.broadcast_to(jnp.asarray(fp.ONE.arr), xs.arr.shape), fp.ONE.bound)
     z = fp.select(live, one, FV(jnp.zeros_like(xs.arr), 1))
     pts = G1Jac(xs, ys, z)
@@ -423,7 +476,7 @@ def g1_aggregate(xs: FV, ys: FV, live) -> G1Jac:
 
 
 def g2_aggregate(xs: F2, ys: F2, live) -> G2Jac:
-    n = xs.c0.arr.shape[0]
+    n = xs.c0.arr.shape[-2]
     one_arr = jnp.broadcast_to(jnp.asarray(fp.ONE.arr), xs.c0.arr.shape)
     zero_arr = jnp.zeros_like(xs.c0.arr)
     z = F2(
@@ -828,15 +881,51 @@ def _hard_part_stage(f_arrs):
     return t2_arrs, t_arrs
 
 
+def _f12_cast_rn(a: F12) -> F12:
+    """Re-tag (or renorm when genuinely fat) every leaf to RN_BOUND —
+    the cheap stage-boundary cast for leaves already inside the bound."""
+    return jax.tree_util.tree_map(
+        lambda v: FV(v.arr, RN_BOUND)
+        if v.bound <= RN_BOUND
+        else fp.renorm_to(v),
+        a,
+        is_leaf=lambda n: isinstance(n, FV),
+    )
+
+
 @jax.jit
 def _finish_stage(t2_arrs, t_arrs, f_arrs, nonempty):
-    """t2 * frob(t,2) * conj(t) * f^3 == 1, gated on nonempty."""
+    """t2 * frob(t,2) * conj(t) * f^3 == 1, gated on nonempty.
+
+    The five-way product runs as ONE scanned multiply over the stacked
+    factors [frob(t,2), conj(t), f, f, f] (f^3 = three f factors —
+    exact field value, order-independent) with carry t2: one f12_mul
+    trace instead of five inlined ones, the same dedup discipline as
+    :func:`_hard_part_stage` (five f12_muls were most of this stage's
+    ~90k stablehlo lines).
+    """
     t2 = _f12_from_arrs(t2_arrs, F12_ONE)
     t = _f12_from_arrs(t_arrs, F12_ONE)
     f = _f12_from_arrs(f_arrs, F12_ONE)
-    out = f12_mul(f12_mul(t2, f12_frob(t, 2)), f12_conj(t))
-    f3 = f12_mul(f12_sqr(f), f)
-    return f12_eq_one(f12_renorm(f12_mul(out, f3))) & nonempty
+    factors = [
+        _f12_cast_rn(f12_frob(t, 2)),
+        _f12_cast_rn(f12_conj(t)),
+        f,
+        f,
+        f,
+    ]
+    xs = [
+        jnp.stack(leaves)
+        for leaves in zip(*(_f12_arrs(fac) for fac in factors))
+    ]
+
+    def body(acc_arrs, factor_arrs):
+        acc = _f12_from_arrs(acc_arrs, F12_ONE)
+        fac = _f12_from_arrs(factor_arrs, F12_ONE)
+        return _f12_arrs(_f12_renorm_to(f12_mul(acc, fac))), None
+
+    out, _ = jax.lax.scan(body, list(t2_arrs), xs)
+    return f12_eq_one(_f12_from_arrs(out, F12_ONE)) & nonempty
 
 
 def aggregate_verify_commit(
@@ -883,3 +972,191 @@ def aggregate_verify_commit(
     f = _easy_part_stage(prod)
     t2, t = _hard_part_stage(f)
     return _finish_stage(t2, t, f, nonempty)
+
+
+# -- device merge trees (ISSUE 12) ------------------------------------------
+# The standalone aggregation kernels: the same scanned masked tree the
+# pairing pipeline uses, exposed as its own dispatch so host consumers
+# (BLSCertifier.build, the aggregation-tree pump, verify/aggregate.py's
+# drop-in aggregate_signatures/aggregate_pubkeys) can merge WITHOUT paying
+# a pairing.  Outputs are CANONICAL Montgomery limbs (one stacked
+# canon_mod_p for all components — the f12_eq_one dedup discipline) so the
+# host unpackers recover exact integers.  Leading batch axes merge many
+# disjoint groups in one dispatch (the tree-gossip pump's per-sweep
+# combine).
+
+
+def _stacked_canon(comps):
+    """Canonicalize N same-shape FVs through ONE canon_mod_p call."""
+    stacked = FV(
+        jnp.stack([c.arr for c in comps], axis=-2),
+        max(c.bound for c in comps),
+    )
+    return fp.canon_mod_p(stacked)  # (..., N, L)
+
+
+@jax.jit
+def g2_merge_tree(sx0, sx1, sy0, sy1, live):
+    """Masked G2 merge tree -> canonical affine limbs + infinity flag.
+
+    Inputs: ``(..., V, L)`` packed canonical Montgomery limbs (V a power
+    of two) and a ``(..., V)`` live mask.  Returns ``(..., 4, L)``
+    canonical limbs (x0, x1, y0, y1 — Montgomery domain, < p) and a
+    ``(...,)`` bool that is True when the masked sum is the point at
+    infinity (the affine limbs are then meaningless zeros).
+    """
+    bnd = P
+
+    def fv(a):
+        return FV(a, bnd)
+
+    agg = g2_aggregate(F2(fv(sx0), fv(sx1)), F2(fv(sy0), fv(sy1)), live)
+    inf = fp.f2_is_zero(agg.z)
+    ax, ay = jac_to_affine_g2(agg)
+    return _stacked_canon([ax.c0, ax.c1, ay.c0, ay.c1]), inf
+
+
+@jax.jit
+def g1_merge_tree(px, py, live):
+    """Masked G1 merge tree -> canonical affine limbs + infinity flag.
+
+    Same contract as :func:`g2_merge_tree` with ``(..., 2, L)`` (x, y)
+    canonical output limbs."""
+    agg = g1_aggregate(FV(px, P), FV(py, P), live)
+    inf = fp.is_zero(fp.renorm(agg.z))
+    ax, ay = jac_to_affine_g1(agg)
+    return _stacked_canon([ax, ay]), inf
+
+
+def unpack_g1_points(limbs, inf) -> list:
+    """Host unpacking: ``(..., 2, L)`` canonical Montgomery limbs (+ the
+    infinity flags) -> affine host points (None for infinity)."""
+    limbs = np.asarray(limbs).reshape(-1, 2, limbs.shape[-1])
+    flags = np.asarray(inf).reshape(-1)
+    out = []
+    for row, is_inf in zip(limbs, flags):
+        if bool(is_inf):
+            out.append(None)
+            continue
+        x, y = fp.from_mont_limbs(row)
+        out.append((x, y))
+    return out
+
+
+def unpack_g2_points(limbs, inf) -> list:
+    """Host unpacking: ``(..., 4, L)`` canonical limbs -> G2 host points."""
+    limbs = np.asarray(limbs).reshape(-1, 4, limbs.shape[-1])
+    flags = np.asarray(inf).reshape(-1)
+    out = []
+    for row, is_inf in zip(limbs, flags):
+        if bool(is_inf):
+            out.append(None)
+            continue
+        x0, x1, y0, y1 = fp.from_mont_limbs(row)
+        out.append(((x0, x1), (y0, y1)))
+    return out
+
+
+# -- batched multi-pairing (ISSUE 12) ---------------------------------------
+# MANY certificates in ONE staged dispatch: per lane i the equation is
+# e(G1, S_i) == e(PK_i, H_i), checked as final_exp(e(G1, S_i) *
+# e(-PK_i, H_i)) == 1.  All 2N Miller loops ride ONE batched scan (the
+# (2, N) leading shape — side-major so lane 0 of the single-cert program
+# is literally the N=1 case), and the final exponentiation reuses the
+# SAME staged jit objects (_easy_part_stage / _hard_part_stage /
+# _finish_stage) the single-certificate pipeline compiled — batching adds
+# exactly ONE new program family (the per-lane pubkey aggregation + the
+# miller product), which scripts/compile_budget.py pins.
+
+
+@jax.jit
+def _multi_g1_neg_aggregate_stage(pk_x, pk_y, live):
+    """Per-lane pubkey aggregation for the multi-pairing pipeline.
+
+    ``(N, V, L)`` packed pubkey limbs + ``(N, V)`` live mask -> the
+    NEGATED affine aggregate per lane (renormed Montgomery limbs, the
+    pairing-ratio form) plus the per-lane nonempty flag.  One scanned
+    tree serves every lane (leading-axis batching of :func:`g1_aggregate`).
+    """
+    agg = g1_aggregate(FV(pk_x, P), FV(pk_y, P), live)
+    nonempty = ~fp.is_zero(fp.renorm(agg.z))
+    ax, ay = jac_to_affine_g1(agg)
+    return (
+        fp.renorm_to(ax).arr,
+        fp.renorm_to(fp.neg(ay)).arr,
+        nonempty,
+    )
+
+
+@jax.jit
+def _multi_miller_stage(qx0, qx1, qy0, qy1, px, py):
+    """All lanes' Miller loops as ONE batched scan, then the per-lane
+    side product.
+
+    Inputs are ``(2, N, L)``: side 0 pairs ``(S_i, G1)``, side 1 pairs
+    ``(H_i, -PK_i)``.  Returns the N per-lane ratio products as raw F12
+    arrs (leading ``(N,)``), renormed to the stage-boundary bound.
+    """
+
+    def rn(a):
+        return FV(a, RN_BOUND)
+
+    f = miller_loop(
+        F2(rn(qx0), rn(qx1)), F2(rn(qy0), rn(qy1)), rn(px), rn(py)
+    )
+
+    def side(i):
+        return jax.tree_util.tree_map(
+            lambda v: FV(v.arr[i], v.bound),
+            f,
+            is_leaf=lambda n: isinstance(n, FV),
+        )
+
+    return _f12_arrs(_f12_renorm_to(f12_mul(side(0), side(1))))
+
+
+def multi_pairing_check(
+    sig_x0,
+    sig_x1,
+    sig_y0,
+    sig_y1,
+    h_x0,
+    h_x1,
+    h_y0,
+    h_y1,
+    pk_x,
+    pk_y,
+    pk_live,
+    lane_live,
+):
+    """N certificate equations in one batched staged dispatch.
+
+    Inputs: per-lane aggregated seal points ``(N, L)`` x4 components,
+    per-lane message points H2(m) ``(N, L)`` x4, per-lane pubkey tables
+    ``(N, V, L)`` x2 with their ``(N, V)`` live masks (V a power of two),
+    and the ``(N,)`` lane-live mask (padding lanes are False and verify
+    False).  Returns an ``(N,)`` bool array — lane i True iff
+    ``e(G1, S_i) == e(sum(pk_i), H_i)`` over that lane's live pubkeys.
+
+    Staged exactly like :func:`aggregate_verify_commit` (same pipeline
+    rationale), with the final-exponentiation stages SHARED — the jit
+    objects are identical, so a process that verified one certificate has
+    already compiled most of the batched program.
+    """
+    npk_x, npk_y, pk_nonempty = _multi_g1_neg_aggregate_stage(
+        jnp.asarray(pk_x), jnp.asarray(pk_y), jnp.asarray(pk_live)
+    )
+    n = npk_x.shape[0]
+    gen_x = jnp.broadcast_to(jnp.asarray(_G1_GEN_X), (n,) + _G1_GEN_X.shape)
+    gen_y = jnp.broadcast_to(jnp.asarray(_G1_GEN_Y), (n,) + _G1_GEN_Y.shape)
+    prod = _multi_miller_stage(
+        jnp.stack([jnp.asarray(sig_x0), jnp.asarray(h_x0)]),
+        jnp.stack([jnp.asarray(sig_x1), jnp.asarray(h_x1)]),
+        jnp.stack([jnp.asarray(sig_y0), jnp.asarray(h_y0)]),
+        jnp.stack([jnp.asarray(sig_y1), jnp.asarray(h_y1)]),
+        jnp.stack([gen_x, npk_x]),
+        jnp.stack([gen_y, npk_y]),
+    )
+    f = _easy_part_stage(prod)
+    t2, t = _hard_part_stage(f)
+    return _finish_stage(t2, t, f, pk_nonempty & jnp.asarray(lane_live))

@@ -192,13 +192,14 @@ class ProofVerifier:
     2. evidence-form exclusivity — an entry carrying BOTH a certificate
        and a seal list is rejected (the sync client's smuggling gate,
        enforced at the serve layer too);
-    3. certificate entries: hash-binding to the served header, then ONE
-       pairing each through :class:`~go_ibft_tpu.crypto.quorum_cert.
-       BLSCertifier` built over the diff-walked sets (so a certificate
-       spliced across a rotation verifies against the RIGHT set — or
-       fails).  Requires ``bls_keys_for_height`` (a PoP-gated registry);
-       a cert-carrying proof without one is a :class:`ProofError`, never
-       silently trusted;
+    3. certificate entries: hash-binding to the served header for EVERY
+       entry first, then ALL surviving certificates through ONE batched
+       multi-pairing dispatch (:meth:`~go_ibft_tpu.crypto.quorum_cert.
+       BLSCertifier.verify_many`, ISSUE 12) built over the diff-walked
+       sets (so a certificate spliced across a rotation verifies against
+       the RIGHT set — or fails).  Requires ``bls_keys_for_height`` (a
+       PoP-gated registry); a cert-carrying proof without one is a
+       :class:`ProofError`, never silently trusted;
     4. seal entries: one batched signature-validity drain for every lane
        not already in the shared :class:`SigVerdictCache` (through the
        scheduler read tier when attached — concurrent callers coalesce),
@@ -338,7 +339,11 @@ class ProofVerifier:
         # rotation verifies against the set the client derived for that
         # height, or fails (the rotation-aware satellite).
         certifier = BLSCertifier(lambda h: sets[h], self._bls_keys)
-        pairings = 0
+        # Hash-binding gates for EVERY entry run before any pairing work
+        # (a relabeled certificate must cost zero crypto — pinned in
+        # tests/test_serve.py); the survivors then verify as ONE batched
+        # multi-pairing dispatch (ISSUE 12: a multi-height cert proof is
+        # one dispatch, not one pairing call per height).
         for entry in cert_entries:
             cert = entry.cert
             if (
@@ -349,15 +354,21 @@ class ProofVerifier:
                     f"height {entry.height}: certificate does not bind "
                     "the served header"
                 )
-            with trace.span("serve.cert_verify", height=entry.height):
-                ok = certifier.verify(cert)
-            if not ok:
+        with trace.span(
+            "serve.cert_verify", heights=len(cert_entries)
+        ):
+            mask = np.asarray(
+                certifier.verify_many([e.cert for e in cert_entries]),
+                dtype=bool,
+            )
+        for entry, ok in zip(cert_entries, mask):
+            if not bool(ok):
                 raise ProofError(
                     f"height {entry.height}: aggregate quorum certificate "
                     "failed verification"
                 )
-            pairings += 1
-            metrics.inc_counter(SERVE_PAIRINGS_KEY)
+        pairings = len(cert_entries)
+        metrics.inc_counter(SERVE_PAIRINGS_KEY, pairings)
         return pairings
 
     @staticmethod

@@ -35,6 +35,7 @@ Two cross-cutting latency planes ride on top (ISSUE 9):
   (height, round, proposal hash, phase, sender, signature) binding.
 """
 
+from .aggregate import G2MergeTree, MultiPairVerifier, multi_aggregate_check
 from .batch import (
     AdaptiveBatchVerifier,
     DeviceBatchVerifier,
@@ -55,13 +56,16 @@ __all__ = [
     "DeviceBatchVerifier",
     "EarlyExitReport",
     "EngineScope",
+    "G2MergeTree",
     "HostBatchVerifier",
     "MalformedLaneError",
     "MeshBatchVerifier",
+    "MultiPairVerifier",
     "PackCache",
     "ResilientBatchVerifier",
     "SpeculationCache",
     "SpeculativeVerifier",
     "VerifyPipeline",
     "SIG_BYTES",
+    "multi_aggregate_check",
 ]

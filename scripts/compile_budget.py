@@ -98,13 +98,49 @@ def _programs() -> dict:
     # MINUTES of compile before any pairing runs.  Lowered at the same
     # 8-lane shape as the other engine-route pins.
     from go_ibft_tpu.bench.bls_workload import build_bls_round_workload
-    from go_ibft_tpu.ops.bls12_381 import aggregate_verify_commit
+    from go_ibft_tpu.ops.bls12_381 import (
+        _multi_miller_stage,
+        aggregate_verify_commit,
+        g2_merge_tree,
+    )
 
     bls_w = build_bls_round_workload(8, time_host=False)
     bls_args = tuple(jnp.asarray(a) for a in bls_w.args)
 
+    # ISSUE 12: the device-resident aggregation pipeline's NEW program
+    # families — the scanned g2 merge tree at the 128-validator bucket
+    # (the mega-committee aggregation kernel; its tree is ONE lax.scan
+    # over halving levels, so growing the bucket must NOT grow the trace
+    # proportionally) and the batched multi-pairing Miller stage at the
+    # 8-lane bucket.  The final-exponentiation stages are deliberately
+    # NOT pinned separately: multi_pairing_check reuses the SAME staged
+    # jit objects aggregate_verify_commit compiled (identity pinned by
+    # tests/test_aggregate.py::test_multipair_reuses_staged_finalexp_
+    # programs), so batched verification adds exactly these two programs
+    # to the budget.  The dp-sharded mesh multipair wraps this same
+    # pipeline in a collective-free shard_map (a thin shell, like
+    # mesh_verify_mask) and is not lowered here — doing so would double
+    # this script's runtime for a per-dp delta the mesh pins already
+    # demonstrate.
+    fe30 = 30  # BLS Fp limb count
+    merge_g2 = jnp.zeros((128, fe30), jnp.int32)
+    merge_live = jnp.zeros((128,), bool)
+    mm = jnp.zeros((2, 8, fe30), jnp.int32)
+
     out = {
         "bls_aggregate_verify_8v": lines(aggregate_verify_commit, *bls_args),
+        "bls_g2_merge_tree_128v": len(
+            g2_merge_tree.lower(
+                merge_g2, merge_g2, merge_g2, merge_g2, merge_live
+            )
+            .as_text()
+            .splitlines()
+        ),
+        "bls_multipair_miller_8l": len(
+            _multi_miller_stage.lower(mm, mm, mm, mm, mm, mm)
+            .as_text()
+            .splitlines()
+        ),
         "quorum_certify_8l": lines(
             quorum.quorum_certify,
             blocks, counts, limbs, limbs, v, addr, table, live, power, power,

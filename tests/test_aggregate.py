@@ -1,0 +1,752 @@
+"""Device-resident aggregation + batched multi-pairing (ISSUE 12).
+
+Covers the tentpole layer by layer: the vmapped g2/g1 merge-tree kernels
+(bit-parity vs the host ``aggregate_signatures`` oracle at uneven lane
+counts, identity/negated-point lanes included), the fast host Miller
+(final-exp parity vs the oracle Miller), the shared-final-exponentiation
+host batch and its bisect-to-oracle unhappy path, the certifier's
+``verify_many`` batch seam, block-sync's ONE-dispatch certificate range
+(the acceptance pin: 1000 certs -> 1 batched dispatch), the serve plane's
+batched cert proofs, and aggregation-tree pump convergence with the
+grouped merger.
+
+Pure-host tests run tier-1; everything that compiles a device kernel
+beyond the small merge-tree shape is in the slow tier (the
+test_bls_device posture).
+"""
+
+import ast
+import inspect
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import bls as hbls
+from go_ibft_tpu.crypto.backend import proposal_hash_of
+from go_ibft_tpu.crypto.quorum_cert import (
+    AggregateQuorumCertificate,
+    BLSCertifier,
+)
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.messages.wire import Proposal
+from go_ibft_tpu.utils import metrics as umetrics
+from go_ibft_tpu.verify import aggregate as vagg
+from go_ibft_tpu.verify.aggregate import (
+    G2MergeTree,
+    MULTIPAIR_DISPATCHES_KEY,
+    MultiPairVerifier,
+    fast_miller,
+    multi_aggregate_check,
+)
+from go_ibft_tpu.verify.bls import aggregate_check, encode_seal
+
+N = 4
+
+
+@pytest.fixture(scope="module")
+def committee():
+    eck = [PrivateKey.from_seed(b"agg-%d" % i) for i in range(N)]
+    blk = [hbls.BLSPrivateKey.from_seed(b"agg-%d" % i) for i in range(N)]
+    powers = {k.address: 1 for k in eck}
+    keys = {e.address: b.pubkey for e, b in zip(eck, blk)}
+    return eck, blk, powers, keys
+
+
+@pytest.fixture(scope="module")
+def certifier(committee):
+    _eck, _blk, powers, keys = committee
+    return BLSCertifier(lambda _h: powers, lambda _h: keys)
+
+
+def _lane(committee, msg, corrupt=False, k=3):
+    _eck, blk, _powers, keys = committee
+    phash = (msg + b"\x00" * 32)[:32]
+    sigs = [b.sign(phash) for b in blk[:k]]
+    if corrupt:
+        sigs[0] = blk[0].sign(b"evil" + b"\x00" * 28)
+    return (
+        phash,
+        [hbls.aggregate_signatures(sigs)],
+        list(keys.values())[:k],
+    )
+
+
+def _cert_for(committee, certifier, height, msg=None):
+    eck, blk, _powers, _keys = committee
+    phash = ((msg or b"cert-h%d" % height) + b"\x00" * 32)[:32]
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(phash)))
+        for e, b in zip(eck[:3], blk[:3])
+    ]
+    cert = certifier.build(height, 0, phash, seals)
+    assert cert is not None
+    return cert
+
+
+# -- merge trees (tier-1: one small kernel shape) ---------------------------
+
+
+def _host_masked_sum(pts, live):
+    acc = None
+    for p, alive in zip(pts, live):
+        if alive:
+            acc = hbls.g2_add(acc, p)
+    return acc
+
+
+def test_g2_merge_tree_parity_uneven_lanes():
+    """7 live lanes in the 8-bucket, incl. a dead lane and a negated
+    sibling pair that partially cancels — bit-parity vs the host fold."""
+    import jax.numpy as jnp
+
+    from go_ibft_tpu.ops import bls12_381 as dev
+
+    pts = [hbls.g2_mul(k, hbls.G2_GEN) for k in (3, 5, 8, 11, 7, 2)]
+    pts.append(hbls.g2_neg(pts[0]))  # negated sibling: cancels lane 0
+    pts.append(hbls.g2_mul(9, hbls.G2_GEN))
+    for live in (
+        [True] * 7 + [False],
+        [True, True, False, True, True, True, True, False],
+    ):
+        x0, x1, y0, y1 = dev.pack_g2_points(pts)
+        limbs, inf = dev.g2_merge_tree(
+            jnp.asarray(x0),
+            jnp.asarray(x1),
+            jnp.asarray(y0),
+            jnp.asarray(y1),
+            jnp.asarray(np.array(live)),
+        )
+        got = dev.unpack_g2_points(
+            np.asarray(limbs)[None], np.asarray(inf)[None]
+        )[0]
+        assert got == _host_masked_sum(pts, live), live
+
+
+def test_g2_merge_tree_identity_lanes():
+    """Total cancellation (P + (-P)) -> the point at infinity, flagged;
+    an all-dead mask likewise."""
+    import jax.numpy as jnp
+
+    from go_ibft_tpu.ops import bls12_381 as dev
+
+    p = hbls.g2_mul(6, hbls.G2_GEN)
+    pts = [p, hbls.g2_neg(p)] + [hbls.g2_mul(4, hbls.G2_GEN)] * 6
+    x0, x1, y0, y1 = dev.pack_g2_points(pts)
+
+    def run(live):
+        limbs, inf = dev.g2_merge_tree(
+            jnp.asarray(x0),
+            jnp.asarray(x1),
+            jnp.asarray(y0),
+            jnp.asarray(y1),
+            jnp.asarray(np.array(live)),
+        )
+        return dev.unpack_g2_points(
+            np.asarray(limbs)[None], np.asarray(inf)[None]
+        )[0]
+
+    assert run([True, True] + [False] * 6) is None
+    assert run([False] * 8) is None
+    assert run([True, False] + [False] * 6) == p
+
+
+def test_merge_groups_host_parity_and_stats():
+    """The grouped merge (host route) folds each group exactly like the
+    oracle loop; empty and cancelled groups come back None."""
+    p = hbls.g2_mul(5, hbls.G2_GEN)
+    groups = [
+        [hbls.g2_mul(3, hbls.G2_GEN), hbls.g2_mul(4, hbls.G2_GEN)],
+        [p, hbls.g2_neg(p)],
+        [],
+        [hbls.g2_mul(12, hbls.G2_GEN)],
+    ]
+    tree = G2MergeTree(device=False)
+    got = tree.merge_groups(groups)
+    assert got[0] == hbls.g2_mul(7, hbls.G2_GEN)
+    assert got[1] is None and got[2] is None
+    assert got[3] == hbls.g2_mul(12, hbls.G2_GEN)
+    assert tree.stats()["host_merges"] == 1
+
+
+def test_merge_tree_demotes_on_device_fault(monkeypatch):
+    """A device fault demotes to the host fold — verdicts unchanged,
+    never an exception (the breaker posture)."""
+
+    def boom(_groups):
+        raise RuntimeError("simulated XLA fault")
+
+    monkeypatch.setattr(vagg, "_merge_g2_groups_device", boom)
+    tree = G2MergeTree(device=True, cutover_points=1)
+    got = tree.merge([hbls.g2_mul(2, hbls.G2_GEN), hbls.g2_mul(3, hbls.G2_GEN)])
+    assert got == hbls.g2_mul(5, hbls.G2_GEN)
+    assert tree.demoted and tree.stats()["faults"] == 1
+    # subsequent merges stay host without touching the device path
+    assert tree.merge([hbls.g2_mul(9, hbls.G2_GEN)]) == hbls.g2_mul(
+        9, hbls.G2_GEN
+    )
+
+
+def test_certifier_build_uses_aggregator(committee):
+    """BLSCertifier.build routed through a merge tree produces the SAME
+    certificate as the host-loop build."""
+    eck, blk, powers, keys = committee
+    plain = BLSCertifier(lambda _h: powers, lambda _h: keys)
+    treed = BLSCertifier(
+        lambda _h: powers,
+        lambda _h: keys,
+        aggregator=G2MergeTree(device=False),
+    )
+    phash = b"b" * 32
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(phash)))
+        for e, b in zip(eck[:3], blk[:3])
+    ]
+    a = plain.build(1, 0, phash, seals)
+    b = treed.build(1, 0, phash, seals)
+    assert a is not None and a.encode() == b.encode()
+
+
+# -- fast host Miller + the host batch --------------------------------------
+
+
+def test_fast_miller_matches_oracle_after_final_exp(committee):
+    """fast_miller differs from the oracle Miller only by subfield line
+    scalings: the final exponentiation of the pairing-ratio product is
+    IDENTICAL, on valid and invalid statements alike."""
+    _eck, blk, _powers, keys = committee
+    msg = b"fm" + b"\x00" * 30
+    sigs = [b.sign(msg) for b in blk[:3]]
+    S = hbls.aggregate_signatures(sigs)
+    PK = hbls.aggregate_pubkeys(list(keys.values())[:3])
+    H = hbls.hash_to_g2(msg)
+    for point in (S, hbls.aggregate_signatures([blk[0].sign(b"x" * 32)] + sigs[1:])):
+        fast = hbls.f12_mul(
+            fast_miller(point, hbls.G1_GEN),
+            fast_miller(H, hbls.g1_neg(PK)),
+        )
+        slow = hbls.f12_mul(
+            hbls.miller_raw(point, hbls.G1_GEN),
+            hbls.miller_raw(H, hbls.g1_neg(PK)),
+        )
+        assert hbls.final_exponentiation(fast) == hbls.final_exponentiation(
+            slow
+        )
+    # the valid statement's product final-exps to one
+    valid = hbls.f12_mul(
+        fast_miller(S, hbls.G1_GEN), fast_miller(H, hbls.g1_neg(PK))
+    )
+    assert hbls.final_exponentiation(valid) == hbls.F12_ONE
+
+
+def test_fs_exponents_salted_and_whole_set_bound(committee):
+    """Batch exponents are odd (never zero), bound to verifier-private
+    salt (an adversary cannot grind them offline), and depend on EVERY
+    lane — changing one lane re-randomizes all exponents even under a
+    fixed salt (the small-exponents soundness requirements)."""
+    lanes = [_lane(committee, b"fs-%d" % i) for i in range(3)]
+    aggs = [vagg._lane_aggregates(lane) for lane in lanes]
+    salt = b"\x07" * 32
+    e1 = vagg._fs_exponents(lanes, aggs, salt)
+    assert e1 == vagg._fs_exponents(lanes, aggs, salt)  # salt-deterministic
+    assert all(e % 2 == 1 for e in e1)
+    assert vagg._fs_exponents(lanes, aggs, b"\x08" * 32) != e1  # salt binds
+    other = [_lane(committee, b"fs-other")] + lanes[1:]
+    oaggs = [vagg._lane_aggregates(lane) for lane in other]
+    e3 = vagg._fs_exponents(other, oaggs, salt)
+    assert e3[1:] != e1[1:]  # untouched lanes' exponents still moved
+
+
+def test_multipair_host_tolerates_none_pubkeys(committee):
+    """A lane carrying None pubkeys (identity elements under the oracle
+    fold) must get the ORACLE verdict on the host-batch route, not a
+    crash (and never demote a MultiPairVerifier)."""
+    phash, points, pks = _lane(committee, b"none-pk")
+    lane = (phash, points, [None] + list(pks))
+    oracle = aggregate_check(*lane)
+    assert multi_aggregate_check([lane], route="host").tolist() == [oracle]
+    all_none = (phash, points, [None, None])
+    assert multi_aggregate_check(
+        [all_none], route="host"
+    ).tolist() == [aggregate_check(*all_none)]
+
+
+def test_multipair_host_parity_with_corrupt_lanes(committee):
+    """Host-batch verdicts == the per-lane oracle, including a corrupt
+    lane (bisect path), a vacuous lane, and a cancelled aggregate."""
+    lanes = [
+        _lane(committee, b"mp-0"),
+        _lane(committee, b"mp-1", corrupt=True),
+        _lane(committee, b"mp-2"),
+    ]
+    # vacuous: no points at all
+    lanes.append((b"\x01" * 32, [], lanes[0][2]))
+    # cancelled to infinity: P + (-P)
+    p = hbls.g2_mul(5, hbls.G2_GEN)
+    lanes.append((b"\x02" * 32, [p, hbls.g2_neg(p)], lanes[0][2]))
+    oracle = np.asarray(
+        [aggregate_check(h, pts, pks) for h, pts, pks in lanes]
+    )
+    got = multi_aggregate_check(lanes, route="host")
+    assert (got == oracle).all()
+    assert oracle.tolist() == [True, False, True, False, False]
+
+
+def test_multipair_python_route_is_oracle(committee):
+    lanes = [_lane(committee, b"py-0"), _lane(committee, b"py-1", corrupt=True)]
+    got = multi_aggregate_check(lanes, route="python")
+    oracle = [aggregate_check(h, p, k) for h, p, k in lanes]
+    assert got.tolist() == oracle
+
+
+def test_multipair_empty_and_unknown_route():
+    assert multi_aggregate_check([], route="host").shape == (0,)
+    with pytest.raises(ValueError):
+        multi_aggregate_check([(b"\x00" * 32, [], [])], route="warp")
+
+
+def test_multipair_host_matches_oracle_on_nonstandard_hash(committee):
+    """The python oracle hashes ANY message bytes; the batched routes
+    must not condemn a short proposal hash the oracle would verify."""
+    _eck, blk, _powers, keys = committee
+    msg = b"short"
+    sigs = [b.sign(msg) for b in blk[:3]]
+    lane = (msg, [hbls.aggregate_signatures(sigs)], list(keys.values())[:3])
+    oracle = aggregate_check(*lane)
+    assert oracle is True
+    assert multi_aggregate_check([lane], route="host").tolist() == [oracle]
+
+
+def test_multipair_verifier_mesh_rung_independent_of_device_flag():
+    """An explicitly-attached mesh is the request for the sharded route
+    — it must appear in the ladder without device=True."""
+    v = MultiPairVerifier(mesh=object())
+    assert v.stats()["rungs"][0] == "mesh"
+
+
+def test_pack_lanes_device_bucket_respects_dp(committee):
+    """The mesh route's lane bucket rises to at least dp, so a small
+    batch still shards cleanly over the mesh axis."""
+    lanes = [_lane(committee, b"dp-pad")]
+    args, live_idx = vagg._pack_lanes_device(lanes, dp=8)
+    assert live_idx == [0]
+    assert args[0].shape[0] == 8  # lane axis padded to dp
+    assert np.asarray(args[-1]).sum() == 1  # exactly one live lane
+
+
+def test_bucket_ladder_never_truncates():
+    """Past the top of a ladder the bucket keeps doubling — a 2000-lane
+    call pads to 2048, it never silently drops lanes."""
+    assert vagg._bucket(7, vagg.MULTIPAIR_BUCKETS) == 8
+    assert vagg._bucket(1024, vagg.MULTIPAIR_BUCKETS) == 1024
+    assert vagg._bucket(2000, vagg.MULTIPAIR_BUCKETS) == 2048
+    assert vagg._bucket(300, vagg.MERGE_BUCKETS) == 512
+    assert vagg._bucket(5000, vagg.GROUP_BUCKETS) == 8192
+
+
+def test_multipair_verifier_demotes_on_fault(committee, monkeypatch):
+    """A faulting device rung demotes to host-batch with verdicts intact
+    and the transition counted (the Resilient ladder posture)."""
+
+    def boom(_lanes, mesh=None):
+        raise RuntimeError("simulated device fault")
+
+    monkeypatch.setattr(vagg, "_device_batch_check", boom)
+    v = MultiPairVerifier(device=True)
+    assert v.route == "device"
+    lanes = [_lane(committee, b"dm-0"), _lane(committee, b"dm-1", corrupt=True)]
+    oracle = [aggregate_check(h, p, k) for h, p, k in lanes]
+    assert v.check(lanes).tolist() == oracle
+    assert v.route == "host" and v.stats()["demotions"] == 1
+    # stays demoted on the next call
+    assert v.check(lanes[:1]).tolist() == oracle[:1]
+    assert v.stats()["demotions"] == 1
+    assert v.stats()["lanes_per_dispatch"] == 1.5
+
+
+# -- certifier batch seam ---------------------------------------------------
+
+
+def test_certifier_verify_many_matches_verify(committee, certifier):
+    """verify_many == verify lane-for-lane: honest certs True,
+    structurally-condemned certs False without pairing work, a
+    pairing-condemned cert False through the batch."""
+    certs = [_cert_for(committee, certifier, h) for h in (1, 2, 3)]
+    relabeled = AggregateQuorumCertificate.decode(certs[0].encode())
+    relabeled.proposal_hash = b"\x55" * 32  # wrong statement -> pairing False
+    short = AggregateQuorumCertificate.decode(certs[1].encode())
+    short.bitmap = AggregateQuorumCertificate.bitmap_of([0], N)  # power short
+    batch = certs + [relabeled, short]
+    expected = np.asarray([certifier.verify(c) for c in batch])
+    eq0 = umetrics.get_counter(vagg.PAIRING_EQS_KEY)
+    got = np.asarray(certifier.verify_many(batch))
+    assert (got == expected).all()
+    assert expected.tolist() == [True, True, True, False, False]
+    # the structurally-short cert never reached the pairing plane: only
+    # the batch product + the bisect for the relabeled lane spent eqs
+    assert umetrics.get_counter(vagg.PAIRING_EQS_KEY) > eq0
+
+
+def test_certifier_verify_many_empty_and_all_bad(committee, certifier):
+    short = AggregateQuorumCertificate(
+        height=1,
+        round=0,
+        proposal_hash=b"\x01" * 32,
+        agg_seal=b"\x00" * 192,
+        bitmap=b"\x00",
+    )
+    assert certifier.verify_many([]).shape == (0,)
+    assert certifier.verify_many([short]).tolist() == [False]
+
+
+# -- block-sync: the ONE-dispatch certificate range -------------------------
+
+
+def _sync_client(committee, certifier):
+    from go_ibft_tpu.chain.sync import LoopbackSyncNetwork, SyncClient
+
+    eck, _blk, powers, _keys = committee
+    return SyncClient(
+        eck[0].address,
+        LoopbackSyncNetwork(),
+        verifier=None,
+        validators_for_height=lambda _h: powers,
+        cert_verifier=certifier,
+    )
+
+
+def _cert_block(committee, certifier, height):
+    from go_ibft_tpu.chain.wal import FinalizedBlock
+
+    proposal = Proposal(raw_proposal=b"sync block %d" % height, round=0)
+    phash = proposal_hash_of(proposal)
+    eck, blk, _powers, _keys = committee
+    seals = [
+        CommittedSeal(e.address, encode_seal(b.sign(phash)))
+        for e, b in zip(eck[:3], blk[:3])
+    ]
+    cert = certifier.build(height, 0, phash, seals)
+    assert cert is not None
+    return FinalizedBlock(height, proposal, [], cert=cert)
+
+
+def test_sync_cert_range_verifies_in_one_dispatch(committee, certifier):
+    """A real-crypto 3-height certificate range: ONE multi-pairing
+    dispatch for the whole range (the PR-6 sync-range pin applied to
+    pairing work)."""
+    from go_ibft_tpu.chain.sync import SYNC_CERT_HEIGHTS_KEY
+
+    client = _sync_client(committee, certifier)
+    blocks = [_cert_block(committee, certifier, h) for h in (5, 6, 7)]
+    d0 = umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY)
+    h0 = umetrics.get_counter(SYNC_CERT_HEIGHTS_KEY)
+    client.verify_blocks(blocks)
+    assert umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY) - d0 == 1
+    assert umetrics.get_counter(SYNC_CERT_HEIGHTS_KEY) - h0 == 3
+
+
+def test_sync_1000_cert_range_single_dispatch(
+    committee, certifier, monkeypatch
+):
+    """The ISSUE 12 acceptance pin: a 1000-certificate catch-up range is
+    ONE batched multi-pairing dispatch.  The pairing core is stubbed (the
+    dispatch-count contract is plumbing; crypto parity is pinned by the
+    real-crypto tests above) but every certificate passes the REAL
+    structural plane (bitmap, power, r-torsion decode)."""
+    calls = []
+
+    def counting_check(lanes, *, route="host", mesh=None):
+        calls.append((len(list(lanes)), route))
+        return np.ones(len(lanes), dtype=bool)
+
+    monkeypatch.setattr(vagg, "multi_aggregate_check", counting_check)
+    eck, blk, _powers, _keys = committee
+    # one REAL aggregate seal reused across heights (decode is cached);
+    # each height binds its own proposal hash via its own certificate
+    from go_ibft_tpu.chain.wal import FinalizedBlock
+
+    agg_seal = encode_seal(blk[0].sign(b"bulk" + b"\x00" * 28))
+    blocks = []
+    for h in range(1, 1001):
+        proposal = Proposal(raw_proposal=b"bulk %d" % h, round=0)
+        phash = proposal_hash_of(proposal)
+        cert = AggregateQuorumCertificate(
+            height=h,
+            round=0,
+            proposal_hash=phash,
+            agg_seal=agg_seal,
+            bitmap=AggregateQuorumCertificate.bitmap_of([0, 1, 2], N),
+        )
+        blocks.append(FinalizedBlock(h, proposal, [], cert=cert))
+    client = _sync_client(committee, certifier)
+    client.verify_blocks(blocks)
+    assert calls == [(1000, "host")], calls
+
+
+def test_sync_cert_failure_names_height(committee, certifier):
+    from go_ibft_tpu.chain.sync import SyncError
+
+    blocks = [_cert_block(committee, certifier, h) for h in (9, 10)]
+    bad = AggregateQuorumCertificate.decode(blocks[1].cert.encode())
+    flipped = bytearray(bad.agg_seal)
+    flipped[3] ^= 0x04
+    bad.agg_seal = bytes(flipped)
+    blocks[1].cert = bad
+    client = _sync_client(committee, certifier)
+    with pytest.raises(SyncError, match="height 10"):
+        client.verify_blocks(blocks)
+
+
+# -- aggregation-tree pump with the grouped merger --------------------------
+
+
+def test_aggtree_pump_converges_with_grouped_merger(committee):
+    """The level-batched pump with a merge_groups merger converges in one
+    sweep and certifies exactly like the per-child host-add pump."""
+    from go_ibft_tpu.messages.wire import (
+        CommitMessage,
+        IbftMessage,
+        MessageType,
+        View,
+    )
+    from go_ibft_tpu.net import AggregationTreeGossip
+
+    eck, blk, powers, keys = committee
+    certifier = BLSCertifier(lambda _h: powers, lambda _h: keys)
+    certs = []
+    hub = AggregationTreeGossip(
+        certifier,
+        fan_in=2,
+        auto_pump=False,
+        merger=G2MergeTree(device=False),
+    )
+    for e in eck:
+        hub.register(e.address, lambda _m: None, certs.append)
+    phash = b"t" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        hub._multicast(
+            i,
+            IbftMessage(
+                view=View(height=1, round=0),
+                sender=e.address,
+                type=MessageType.COMMIT,
+                commit_data=CommitMessage(
+                    proposal_hash=phash,
+                    committed_seal=encode_seal(b.sign(phash)),
+                ),
+            ),
+        )
+    hub.pump()
+    assert hub.certs_built == 1
+    # every node's deliver_cert fired and the certificate verifies
+    assert len(certs) == N
+    assert certifier.verify(certs[0])
+    assert hub.merger.stats()["host_merges"] >= 1
+
+
+# -- serve plane: batched cert proofs ---------------------------------------
+
+
+def test_serve_multi_cert_proof_batched(committee, certifier):
+    """A 3-height all-certificate proof verifies through ONE batched
+    dispatch with pairings == heights (the per-cert accounting clients
+    already pin)."""
+    from go_ibft_tpu.serve.proof import FinalityProof, ProofEntry
+    from go_ibft_tpu.serve.server import ProofVerifier
+
+    _eck, _blk, powers, keys = committee
+    entries = []
+    for h in (1, 2, 3):
+        proposal = Proposal(raw_proposal=b"serve cert %d" % h, round=0)
+        phash = proposal_hash_of(proposal)
+        eck, blk, _p, _k = committee
+        seals = [
+            CommittedSeal(e.address, encode_seal(b.sign(phash)))
+            for e, b in zip(eck[:3], blk[:3])
+        ]
+        cert = certifier.build(h, 0, phash, seals)
+        entries.append(ProofEntry(height=h, proposal=proposal, cert=cert))
+    proof = FinalityProof(checkpoint_height=0, entries=entries, diffs=[])
+    verifier = ProofVerifier(bls_keys_for_height=lambda _h: keys)
+    d0 = umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY)
+    report = verifier.verify(proof, powers)
+    assert report["pairings"] == 3
+    assert umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY) - d0 == 1
+
+
+def test_serve_relabeled_cert_still_rejected_before_pairing(
+    committee, certifier
+):
+    """Hash binding still precedes ALL pairing work on the batched route."""
+    from go_ibft_tpu.serve.proof import FinalityProof, ProofEntry
+    from go_ibft_tpu.serve.server import ProofVerifier
+    from go_ibft_tpu.serve.proof import ProofError
+
+    _eck, _blk, powers, keys = committee
+    cert = _cert_for(committee, certifier, 1, b"genuine")
+    other = Proposal(raw_proposal=b"other header", round=0)
+    proof = FinalityProof(
+        checkpoint_height=0,
+        entries=[ProofEntry(height=1, proposal=other, cert=cert)],
+        diffs=[],
+    )
+    verifier = ProofVerifier(bls_keys_for_height=lambda _h: keys)
+    d0 = umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY)
+    with pytest.raises(ProofError, match="does not bind"):
+        verifier.verify(proof, powers)
+    assert verifier.pairings == 0
+    assert umetrics.get_counter(MULTIPAIR_DISPATCHES_KEY) - d0 == 0
+
+
+# -- program-identity pin ---------------------------------------------------
+
+
+def test_multipair_reuses_staged_finalexp_programs():
+    """multi_pairing_check must call the SAME staged final-exponentiation
+    jit objects the single-certificate pipeline compiled (_easy_part_
+    stage / _hard_part_stage / _finish_stage) — a fork would add a second
+    ~200k-line program family to the compile budget."""
+    from go_ibft_tpu.ops import bls12_381 as dev
+
+    src = inspect.getsource(dev.multi_pairing_check)
+    tree = ast.parse(src)
+    called = {
+        node.func.id
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+    }
+    assert {"_easy_part_stage", "_hard_part_stage", "_finish_stage"} <= called
+
+
+# -- slow tier: big merge shapes + device/mesh multipair --------------------
+
+
+@pytest.mark.slow
+def test_g2_merge_tree_parity_big_lanes():
+    """Lane counts 1 and 67 and 128 (buckets 2 and 128) vs the host
+    oracle — the mega-committee shapes."""
+    import jax.numpy as jnp
+
+    from go_ibft_tpu.ops import bls12_381 as dev
+
+    for n, bucket in ((1, 2), (67, 128), (128, 128)):
+        pts = [
+            hbls.g2_mul(3 + 2 * i, hbls.G2_GEN) for i in range(n)
+        ]
+        want = None
+        for p in pts:
+            want = hbls.g2_add(want, p)
+        x0, x1, y0, y1 = dev.pack_g2_points(pts + [None] * (bucket - n))
+        live = np.zeros(bucket, dtype=bool)
+        live[:n] = True
+        limbs, inf = dev.g2_merge_tree(
+            jnp.asarray(x0),
+            jnp.asarray(x1),
+            jnp.asarray(y0),
+            jnp.asarray(y1),
+            jnp.asarray(live),
+        )
+        got = dev.unpack_g2_points(
+            np.asarray(limbs)[None], np.asarray(inf)[None]
+        )[0]
+        assert got == want, n
+
+
+@pytest.mark.slow
+def test_g1_merge_tree_parity_big_lanes():
+    import jax.numpy as jnp
+
+    from go_ibft_tpu.ops import bls12_381 as dev
+
+    n, bucket = 67, 128
+    pts = [hbls.g1_mul(2 + i, hbls.G1_GEN) for i in range(n)]
+    want = None
+    for p in pts:
+        want = hbls.g1_add(want, p)
+    px, py = dev.pack_g1_points(pts + [None] * (bucket - n))
+    live = np.zeros(bucket, dtype=bool)
+    live[:n] = True
+    limbs, inf = dev.g1_merge_tree(
+        jnp.asarray(px), jnp.asarray(py), jnp.asarray(live)
+    )
+    got = dev.unpack_g1_points(
+        np.asarray(limbs)[None], np.asarray(inf)[None]
+    )[0]
+    assert got == want
+
+
+@pytest.mark.slow
+def test_multipair_device_parity(committee):
+    """Device batched verdicts == the per-lane oracle, corrupt lane
+    included (one staged dispatch; the pairing program compile is cached
+    persistently)."""
+    lanes = [
+        _lane(committee, b"dev-0"),
+        _lane(committee, b"dev-1", corrupt=True),
+        _lane(committee, b"dev-2"),
+    ]
+    oracle = [aggregate_check(h, p, k) for h, p, k in lanes]
+    got = multi_aggregate_check(lanes, route="device")
+    assert got.tolist() == oracle
+
+
+@pytest.mark.slow
+def test_multipair_mesh_parity(committee):
+    """dp-sharded multipair (masked lane padding to bucket x dp) == the
+    oracle on a 2-device forced-host mesh."""
+    import jax
+
+    from go_ibft_tpu.parallel import mesh_context
+
+    mesh = mesh_context(2, devices=jax.devices()[:2])
+    if mesh is None:
+        pytest.skip("needs >= 2 visible devices")
+    lanes = [
+        _lane(committee, b"mesh-%d" % i, corrupt=(i == 1)) for i in range(4)
+    ]
+    oracle = [aggregate_check(h, p, k) for h, p, k in lanes]
+    got = multi_aggregate_check(lanes, route="mesh", mesh=mesh)
+    assert got.tolist() == oracle
+
+
+@pytest.mark.slow
+def test_aggtree_pump_converges_with_device_merger(committee):
+    """The vmapped device combine drives the pump to the same certificate
+    as the host fold."""
+    from go_ibft_tpu.messages.wire import (
+        CommitMessage,
+        IbftMessage,
+        MessageType,
+        View,
+    )
+    from go_ibft_tpu.net import AggregationTreeGossip
+
+    eck, blk, powers, keys = committee
+    certifier = BLSCertifier(lambda _h: powers, lambda _h: keys)
+    certs = []
+    hub = AggregationTreeGossip(
+        certifier,
+        fan_in=2,
+        auto_pump=False,
+        merger=G2MergeTree(device=True, cutover_points=1),
+    )
+    for e in eck:
+        hub.register(e.address, lambda _m: None, certs.append)
+    phash = b"v" * 32
+    for i, (e, b) in enumerate(zip(eck, blk)):
+        hub._multicast(
+            i,
+            IbftMessage(
+                view=View(height=1, round=0),
+                sender=e.address,
+                type=MessageType.COMMIT,
+                commit_data=CommitMessage(
+                    proposal_hash=phash,
+                    committed_seal=encode_seal(b.sign(phash)),
+                ),
+            ),
+        )
+    hub.pump()
+    assert hub.certs_built == 1 and len(certs) == N
+    assert certifier.verify(certs[0])
+    assert hub.merger.stats()["device_merges"] >= 1

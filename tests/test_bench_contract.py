@@ -71,6 +71,7 @@ _FIVE_CONFIG_KEYS = (
     "multi_tenant_blocks_per_s",
     "commit_critical_path_100v",
     "proof_serving_100v",
+    "batched_multipairing_1000c",
     bench.headline_metric(True),
 )
 
@@ -356,6 +357,59 @@ def test_driver_conditions_config12_proof_serving_evidence(driver_run):
     assert qos["chain_heights"] > 0 and qos["chain_nodes"] >= 4
     assert qos["flood_proofs"] > 0  # and the read tier still progressed
     assert line["oracle_exact"] is True
+
+
+def test_driver_conditions_config13_multipair_evidence(driver_run):
+    """Config #13's evidence schema (ISSUE 12): a MEASURED batched-vs-
+    sequential multi-pairing line — N certificates through ONE batched
+    dispatch (the dispatch count is part of the line) against the
+    per-cert aggregate_check loop, verdicts oracle-gated on a seeded
+    corrupt set BEFORE timing, with the committee-size sweep dict that
+    finally gives config #9's chip-blocked device_sizes a host-route
+    measurement.  The >=5x acceptance floor is asserted inside the
+    config itself whenever it runs >= 8 lanes."""
+    _, by_metric, _ = driver_run
+    line = by_metric["batched_multipairing_1000c"]
+    assert line["value"] > 0
+    for field in (
+        "ratio",
+        "certs",
+        "sequential_ms",
+        "batched_ms",
+        "dispatches",
+        "lanes_per_dispatch",
+        "route",
+        "committee_sizes",
+    ):
+        assert field in line, (field, line)
+    assert line["vs_baseline"] == line["ratio"]
+    assert line["dispatches"] == 1
+    assert line["lanes_per_dispatch"] == line["certs"]
+    assert line["oracle_exact"] is True
+    assert line["corrupt_gate"]["oracle_exact"] is True
+    assert line["corrupt_gate"]["corrupted"] >= 2
+    if line["certs"] >= 8:
+        assert line["ratio"] >= 5.0
+    # the sweep dict exists; entries are either measured or explicitly
+    # budget-skipped (never silently absent)
+    for size, entry in line["committee_sizes"].items():
+        assert ("host_agg_ms" in entry) or ("skipped" in entry.get("note", "")), (
+            size,
+            entry,
+        )
+
+
+def test_multipair_only_flag_scopes_evidence_contract():
+    """`bench.py --multipair-only` (the make multipair-bench entry) runs
+    ONLY config #13 and scopes the rc=0 evidence contract to it — static
+    check on _run, like the other --*-only pins."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "multipair_only" in src
+    assert "config13_multipair" in src
 
 
 def test_serve_only_flag_scopes_evidence_contract():

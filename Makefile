@@ -3,7 +3,7 @@
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
-	serve-bench timeline-smoke slo-gates multipair-bench
+	serve-bench timeline-smoke slo-gates multipair-bench cost-report
 
 test:
 	python -m pytest tests/ -q
@@ -105,6 +105,15 @@ ab-keccak:
 # best prior BENCH_r*.json on the same backend (go_ibft_tpu/obs/gates.py)
 obs-report:
 	python scripts/obs_report.py
+
+# Runtime cost-ledger smoke (ISSUE 14, fast-tier CI): a small host-route
+# drain with the ledger on must render the per-program report (top
+# programs by device time, live-vs-padded occupancy, compile table) with
+# every pinned compile-budget family that ran appearing in it.  After a
+# bench run, `python scripts/cost_report.py` (no --drain) reports over
+# the run's cost_ledger.json / compile_ledger.jsonl instead.
+cost-report:
+	JAX_PLATFORMS=cpu python scripts/cost_report.py --drain --check
 
 # Telemetry-plane smoke (ISSUE 11, fast-tier CI): a 4-node loopback chain
 # with /metrics,/healthz,/statusz mounted is scraped WHILE finalizing,

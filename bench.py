@@ -2861,6 +2861,29 @@ def main(argv=None) -> None:
         "trace to this path at exit",
     )
     parser.add_argument(
+        "--device-trace",
+        metavar="OUT_DIR",
+        default=None,
+        help="capture a jax.profiler window over the whole run "
+        "(go_ibft_tpu.obs.devprof); with --trace the device ops merge "
+        "into the exported Perfetto document so one file shows consensus "
+        "phases over host spans over device ops",
+    )
+    parser.add_argument(
+        "--compile-ledger",
+        default=os.environ.get("GO_IBFT_COMPILE_LEDGER", "compile_ledger.jsonl"),
+        help="append-only JSONL the cost ledger writes one record per XLA "
+        "compilation to (program, duration, call-site — the ROADMAP-item-5 "
+        "AOT-manifest baseline)",
+    )
+    parser.add_argument(
+        "--cost-ledger",
+        default=os.environ.get("GO_IBFT_COST_LEDGER", "cost_ledger.json"),
+        help="full cost-ledger snapshot (per-program dispatches, "
+        "occupancy, device_ms, compiles) dumped at exit; "
+        "scripts/cost_report.py renders it",
+    )
+    parser.add_argument(
         "--reprobe",
         action="store_true",
         help="bypass the TTL'd backend-fingerprint cache "
@@ -2914,6 +2937,8 @@ def main(argv=None) -> None:
         "GO_IBFT_SERVE_CLIENTS overrides the client count)",
     )
     args = parser.parse_args(argv)
+    from go_ibft_tpu.obs import ledger as cost_ledger
+
     if args.trace:
         # Sized for the full config matrix WITH per-message net.send/
         # net.recv propagation records (ISSUE 11): the ring must not wrap
@@ -2921,13 +2946,52 @@ def main(argv=None) -> None:
         # drain pins droppedRecords == 0, because a truncated window
         # orphans spans at the wrap boundary.
         obs_trace.enable(1 << 19)
+    # The cost ledger is ALWAYS on for a bench run (ISSUE 14): its
+    # per-dispatch tax is microseconds against millisecond dispatches,
+    # every evidence line gets a ledger block stamped by the
+    # EvidenceWriter, and the compile ledger is the run's cold-compile
+    # record.  Production hot paths stay on the one-predicate disabled
+    # path — only explicit enables (here, telemetry mounts) turn it on.
+    cost_ledger.enable(compile_log=args.compile_ledger)
+    device_meta = None
     try:
-        _run(args)
+        if args.device_trace:
+            from go_ibft_tpu.obs import devprof
+
+            with devprof.window(args.device_trace) as device_meta:
+                _run(args)
+        else:
+            _run(args)
     finally:
         if args.trace:
             from go_ibft_tpu.obs.export import write_chrome_trace
 
             n_events = write_chrome_trace(args.trace)
+            if device_meta is not None and device_meta.get("path"):
+                # Merge the device window into the host timeline: one
+                # Perfetto doc, consensus phases over host spans over
+                # device ops (obs/timeline.py).  Guarded: a truncated or
+                # malformed profiler artifact must degrade to "no device
+                # rows" — never abort this finally block (the ledger
+                # dump, evidence close, and the run's own exit status
+                # all come after it).
+                try:
+                    from go_ibft_tpu.obs import timeline as obs_timeline
+
+                    with open(args.trace) as fh:
+                        doc = json.load(fh)
+                    obs_timeline.merge_device_trace(
+                        doc,
+                        device_meta["path"],
+                        host_anchor_us=device_meta.get("host_anchor_us"),
+                    )
+                    with open(args.trace, "w") as fh:
+                        json.dump(doc, fh)
+                except Exception as err:  # noqa: BLE001
+                    device_meta["error"] = (
+                        f"device-trace merge failed: {type(err).__name__}: "
+                        f"{err}"[:200]
+                    )
             rec = obs_trace.recorder()
             # Ring overflow orphans spans near the wrap boundary (their
             # children were overwritten first) — surface it so nobody
@@ -2940,6 +3004,34 @@ def main(argv=None) -> None:
                     "dropped_records": rec.dropped if rec is not None else 0,
                 }
             )
+        if device_meta is not None:
+            _log(
+                {
+                    "metric": "device_trace",
+                    "value": device_meta.get("path"),
+                    "ok": device_meta.get("ok", False),
+                    "error": device_meta.get("error"),
+                }
+            )
+        snap = cost_ledger.snapshot()
+        if snap is not None:
+            try:
+                with open(args.cost_ledger, "w") as fh:
+                    json.dump(snap, fh, indent=1)
+                totals = cost_ledger.totals()
+                _log(
+                    {
+                        "metric": "cost_ledger",
+                        "value": totals["dispatches"],
+                        "unit": "dispatches",
+                        "path": args.cost_ledger,
+                        "compile_ledger": args.compile_ledger,
+                        **totals,
+                    }
+                )
+            except OSError:
+                pass
+        cost_ledger.disable()
         if _EVIDENCE is not None:
             _EVIDENCE.close()
 

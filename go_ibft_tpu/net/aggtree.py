@@ -71,6 +71,7 @@ from ..crypto import bls as hbls
 from ..crypto.quorum_cert import AggregateQuorumCertificate, BLSCertifier
 from ..messages.helpers import extract_commit_hash, extract_committed_seal
 from ..messages.wire import IbftMessage, MessageType
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..utils import metrics
 from ..verify.bls import decode_seal, encode_seal
@@ -334,7 +335,11 @@ class AggregationTreeGossip:
         the whole level through :attr:`merger`, or the host fold."""
         groups = [pts for _i, _key, _signers, pts in work]
         if self.merger is not None:
-            return self.merger.merge_groups(groups)
+            # route_tag: the merge-tree dispatch this issues records in
+            # the cost ledger as ``aggtree/device``, splitting the gossip
+            # pump's per-sweep combines from certifier-build merges.
+            with cost_ledger.route_tag("aggtree"):
+                return self.merger.merge_groups(groups)
         out = []
         for pts in groups:
             point = None

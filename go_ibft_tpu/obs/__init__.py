@@ -17,13 +17,19 @@ Three pillars (ISSUE 4):
   per-record-flushed JSONL evidence writer so every bench config leaves a
   record even when the run crashes mid-way.  Supersedes
   ``go_ibft_tpu.bench.evidence``.
+* :mod:`~go_ibft_tpu.obs.ledger` / :mod:`~go_ibft_tpu.obs.devprof` —
+  the runtime cost ledger (ISSUE 14): per-dispatch device-time
+  attribution keyed by compile-budget program names, live-vs-padded
+  lane occupancy, compile-event tracing into ``compile_ledger.jsonl``,
+  and on-demand ``jax.profiler`` windows (``/profilez``,
+  ``bench.py --device-trace``) merged into the Perfetto timeline.
 * :mod:`~go_ibft_tpu.obs.gates` — regression gates comparing a fresh
   evidence file against the best prior ``BENCH_r*.json`` per config on the
   same backend (``scripts/obs_report.py`` / ``make obs-report``), so
   CPU-fallback rounds still catch regressions without a chip.
 """
 
-from . import clock, trace
+from . import clock, devprof, ledger, trace
 from .evidence import EvidenceWriter, Fingerprint, probe_fingerprint
 from .export import to_chrome_trace, write_chrome_trace
 from .gates import GateResult, gate_evidence, gate_slo_records, render_table
@@ -33,6 +39,8 @@ from .recorder import RingRecorder
 
 __all__ = [
     "clock",
+    "devprof",
+    "ledger",
     "trace",
     "EvidenceWriter",
     "Fingerprint",

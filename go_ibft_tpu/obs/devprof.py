@@ -1,0 +1,186 @@
+"""On-demand device profiling: jax.profiler trace windows for the ledger.
+
+The cost ledger (:mod:`go_ibft_tpu.obs.ledger`) attributes *wall* time
+per program; this module captures what the device itself was doing —
+a ``jax.profiler`` window whose Chrome-format output
+(``*.trace.json.gz``) merges into the PR-11 Perfetto document via
+:func:`go_ibft_tpu.obs.timeline.merge_device_trace`, so ONE file shows
+consensus phases over host spans over device ops.
+
+Two entry points:
+
+* :func:`capture` — a fixed-length window (the ``/profilez`` endpoint:
+  ``GET /profilez?seconds=0.5`` on a live
+  :class:`~go_ibft_tpu.obs.httpd.TelemetryServer`);
+* :func:`window` — a context manager wrapping a whole run
+  (``bench.py --device-trace OUT_DIR``).
+
+Both stamp ``host_anchor_us`` — the flight recorder's monotonic
+microsecond clock read immediately after ``start_trace`` — so the merge
+can rebase device timestamps (which are relative to the profiler
+session) onto the exported host trace's clock.  Alignment is anchor-
+based and therefore approximate to within the ``start_trace`` call
+overhead (sub-millisecond); the per-track orderings inside either source
+stay exact.
+
+The profiler is a process-global singleton in jax: captures serialize on
+a module lock, and a second concurrent request reports ``busy`` instead
+of corrupting the open session.  Every failure path returns a dict with
+``ok: False`` and a reason — a profiling request must never take down a
+telemetry endpoint or a bench run.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["capture", "window", "newest_trace"]
+
+_lock = threading.Lock()
+
+# Anchored capture dir for parameterless captures (the /profilez
+# endpoint): ONE per-process directory, pruned before each new window so
+# a scraper polling /profilez forever holds at most one trace on disk.
+# Callers that pass their own out_dir own its lifecycle.
+_default_dir: Optional[str] = None
+
+MIN_SECONDS = 0.05
+MAX_SECONDS = 30.0
+
+
+def _default_capture_dir() -> str:
+    global _default_dir
+    if _default_dir is None or not os.path.isdir(_default_dir):
+        _default_dir = tempfile.mkdtemp(prefix="go-ibft-profilez-")
+    else:
+        # Keep only the latest window: the profiler nests each run under
+        # plugins/profile/<timestamp>/ and never reuses one.
+        for entry in os.listdir(_default_dir):
+            shutil.rmtree(
+                os.path.join(_default_dir, entry), ignore_errors=True
+            )
+    return _default_dir
+
+
+def newest_trace(out_dir: str) -> Optional[str]:
+    """The most recent ``*.trace.json.gz`` under ``out_dir`` (the
+    profiler nests runs under ``plugins/profile/<timestamp>/``)."""
+    paths = glob.glob(
+        os.path.join(out_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not paths:
+        return None
+    return max(paths, key=os.path.getmtime)
+
+
+def _start(out_dir: str) -> Optional[str]:
+    """Start a profiler session; returns an error string or None."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+    except Exception as err:  # noqa: BLE001 - report, never raise
+        return f"{type(err).__name__}: {err}"
+    return None
+
+
+def _stop() -> Optional[str]:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as err:  # noqa: BLE001
+        return f"{type(err).__name__}: {err}"
+    return None
+
+
+def capture(seconds: float = 0.5, out_dir: Optional[str] = None) -> dict:
+    """Capture one fixed-length profiler window.
+
+    Returns ``{"ok", "dir", "path", "host_anchor_us", "seconds"}`` —
+    ``path`` is the Chrome-format trace the window produced (None plus an
+    ``error`` when the profiler is unavailable, already busy, or wrote
+    nothing).
+
+    Without ``out_dir`` the capture lands in one per-process directory
+    that is PRUNED before each new window — a scraper polling /profilez
+    holds at most one trace on disk, so copy the file before requesting
+    another window.  An explicit ``out_dir`` is never pruned.
+    """
+    seconds = min(MAX_SECONDS, max(MIN_SECONDS, float(seconds)))
+    if not _lock.acquire(blocking=False):
+        return {"ok": False, "error": "busy: a profiler window is already open"}
+    try:
+        out_dir = out_dir or _default_capture_dir()
+        err = _start(out_dir)
+        if err is not None:
+            return {"ok": False, "error": err, "dir": out_dir}
+        anchor_us = time.perf_counter_ns() // 1000
+        time.sleep(seconds)
+        err = _stop()
+        if err is not None:
+            return {"ok": False, "error": err, "dir": out_dir}
+        path = newest_trace(out_dir)
+        meta = {
+            "ok": path is not None,
+            "dir": out_dir,
+            "path": path,
+            "host_anchor_us": anchor_us,
+            "seconds": seconds,
+        }
+        if path is None:
+            meta["error"] = "profiler window produced no .trace.json.gz"
+        return meta
+    finally:
+        _lock.release()
+
+
+@contextmanager
+def window(out_dir: str):
+    """Profile everything inside the block (``bench.py --device-trace``).
+
+    Yields the capture metadata dict; ``path`` / ``ok`` are filled in
+    when the block exits (read them AFTER the with-statement).  A
+    profiler that fails to start yields ``ok: False`` and the block runs
+    unprofiled — a dead profiler must not kill a bench run.
+    """
+    meta: dict = {"ok": False, "dir": out_dir, "path": None}
+    if not _lock.acquire(blocking=False):
+        meta["error"] = "busy: a profiler window is already open"
+        yield meta
+        return
+    started = False
+    try:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+        except OSError as mkdir_err:
+            # An unwritable --device-trace target must degrade like a
+            # dead profiler: the wrapped run proceeds unprofiled.
+            meta["error"] = f"{type(mkdir_err).__name__}: {mkdir_err}"
+            yield meta
+            return
+        err = _start(out_dir)
+        if err is None:
+            started = True
+            meta["host_anchor_us"] = time.perf_counter_ns() // 1000
+        else:
+            meta["error"] = err
+        yield meta
+    finally:
+        if started:
+            err = _stop()
+            if err is not None:
+                meta["error"] = err
+            else:
+                meta["path"] = newest_trace(out_dir)
+                meta["ok"] = meta["path"] is not None
+                if meta["path"] is None:
+                    meta["error"] = "profiler window produced no .trace.json.gz"
+        _lock.release()

@@ -190,6 +190,11 @@ class EvidenceWriter:
         self.devices = devices
         self._fh = open(path, "w" if truncate else "a")
         self._configs: List[str] = []
+        # Cost-ledger totals at the previous record (ISSUE 14): each line
+        # carries the DELTA since the line before it, so per-config
+        # dispatch counts and occupancy are readable straight off the
+        # evidence and obs/gates.py can regression-gate their growth.
+        self._ledger_last: Optional[dict] = None
 
     def set_provenance(
         self, backend: str, probe: str, devices: Optional[int] = None
@@ -208,6 +213,9 @@ class EvidenceWriter:
         rec.setdefault("backend", self.backend)
         rec.setdefault("probe", self.probe)
         rec.setdefault("devices", self.devices)
+        block = self._ledger_block()
+        if block is not None:
+            rec.setdefault("ledger", block)
         rec["ts"] = time.time()
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
@@ -217,6 +225,37 @@ class EvidenceWriter:
             pass
         self._configs.append(config)
         return rec
+
+    def _ledger_block(self) -> Optional[dict]:
+        """Cost-ledger delta since the previous record (None when the
+        ledger is off): dispatches, device_ms, live/padded lanes + the
+        derived occupancy, compiles, compile_ms — the per-config stamp
+        ``scripts/obs_report.py`` / ``obs/gates.py`` regression-gate."""
+        from . import ledger as cost_ledger
+
+        cur = cost_ledger.totals()
+        if cur is None:
+            self._ledger_last = None
+            return None
+        prev = self._ledger_last or {}
+        self._ledger_last = cur
+        block = {
+            key: round(cur[key] - prev.get(key, 0), 3)
+            for key in (
+                "dispatches",
+                "live_lanes",
+                "padded_lanes",
+                "device_ms",
+                "compiles",
+                "compile_ms",
+            )
+        }
+        block["occupancy"] = (
+            round(block["live_lanes"] / block["padded_lanes"], 4)
+            if block["padded_lanes"]
+            else None
+        )
+        return block
 
     def covered(self) -> List[str]:
         """Configs recorded so far, in order."""

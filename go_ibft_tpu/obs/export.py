@@ -87,7 +87,11 @@ def to_chrome_trace(
         elif ph == "i":
             event["s"] = "t"  # thread-scoped instant
         events.append(event)
-    other = {"droppedRecords": dropped}
+    # Raw-clock anchor of the rebase: ts 0 in this document is this raw
+    # monotonic microsecond.  Device-trace merging (obs/timeline.py::
+    # merge_device_trace) uses it to place a jax.profiler window — whose
+    # own timestamps are session-relative — onto this document's clock.
+    other = {"droppedRecords": dropped, "clockBaseUs": base}
     if node is not None:
         other["node"] = node
     if clock_offsets is not None:

@@ -46,6 +46,10 @@ __all__ = [
     "append_slo_records",
     "parse_slo_records",
     "gate_slo_records",
+    "ledger_metric_lines",
+    "gate_ledger_evidence",
+    "LEDGER_WARN_PCT",
+    "LEDGER_FAIL_PCT",
 ]
 
 WARN_PCT = 10.0
@@ -67,6 +71,8 @@ _NON_CONFIG_METRICS = frozenset(
         "tpu_reprobe",
         "adaptive_cutover_calibration",
         "trace_export",
+        "cost_ledger",
+        "device_trace",
     }
 )
 
@@ -303,6 +309,162 @@ def render_table(results: List[GateResult]) -> str:
         if i == 0:
             out.append("-" * len(out[0]))
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Cost-ledger gates (ISSUE 14): per-config dispatch-count + occupancy
+# ---------------------------------------------------------------------------
+#
+# Every evidence line carries a ``ledger`` block (the delta the config
+# cost — see ``obs/evidence.py::EvidenceWriter._ledger_block``).  These
+# gates grade the two values that regress silently: dispatch count (a
+# batching regression shows up as MORE launches for the same work — the
+# thresholds are tight because counts are near-deterministic per config)
+# and live-lane occupancy (bucket-padding waste creeping up as buckets
+# drift away from real lane counts).
+
+LEDGER_WARN_PCT = 5.0
+LEDGER_FAIL_PCT = 30.0
+
+
+def ledger_metric_lines(lines: Iterable[dict]) -> List[dict]:
+    """Synthesize gateable metric lines from evidence-line ledger blocks.
+
+    ``<config>.ledger_dispatches`` (lower is better) and
+    ``<config>.ledger_occupancy`` (higher is better — the ``/s``-free
+    unit is special-cased in :func:`gate_ledger_evidence`).  Lines
+    without a ledger block (pre-ISSUE-14 artifacts, ledger-off runs)
+    yield nothing, so old rounds grade ``info``.
+    """
+    out: List[dict] = []
+    for line in lines:
+        metric = line.get("metric")
+        block = line.get("ledger")
+        if (
+            metric is None
+            or metric in _NON_CONFIG_METRICS
+            or not isinstance(block, dict)
+        ):
+            continue
+        dispatches = block.get("dispatches")
+        if isinstance(dispatches, (int, float)) and dispatches > 0:
+            out.append(
+                {
+                    "metric": f"{metric}.ledger_dispatches",
+                    "value": dispatches,
+                    "unit": "dispatches",
+                    "backend": line.get("backend"),
+                }
+            )
+            occupancy = block.get("occupancy")
+            if isinstance(occupancy, (int, float)):
+                out.append(
+                    {
+                        "metric": f"{metric}.ledger_occupancy",
+                        "value": occupancy,
+                        "unit": "fraction",
+                        "backend": line.get("backend"),
+                    }
+                )
+    return out
+
+
+def _ledger_higher_is_better(metric: str) -> bool:
+    return metric.endswith(".ledger_occupancy")
+
+
+def gate_ledger_evidence(
+    fresh_lines: Iterable[dict],
+    repo_dir: str = ".",
+    *,
+    backend: Optional[str] = None,
+    warn_pct: float = LEDGER_WARN_PCT,
+    fail_pct: float = LEDGER_FAIL_PCT,
+    exclude: Tuple[str, ...] = (),
+) -> List[GateResult]:
+    """Grade fresh ledger blocks against the best prior round, same
+    backend (the :func:`gate_evidence` posture applied to the synthetic
+    ledger metrics).  Configs whose priors carry no ledger block report
+    ``info`` — the gate arms itself as rounds accumulate."""
+    fresh_lines = list(fresh_lines)
+    if backend is None:
+        backend = artifact_backend(fresh_lines)
+    fresh = {
+        line["metric"]: line for line in ledger_metric_lines(fresh_lines)
+    }
+    prior: Dict[str, Tuple[float, str]] = {}
+    paths = sorted(
+        glob.glob(os.path.join(repo_dir, "BENCH_r*.json")), key=_round_of
+    )
+    for path in paths:
+        name = os.path.basename(path)
+        if name in exclude:
+            continue
+        try:
+            lines = parse_artifact(path)
+        except OSError:
+            continue
+        if artifact_backend(lines) != backend:
+            continue
+        for synth in ledger_metric_lines(lines):
+            metric, value = synth["metric"], float(synth["value"])
+            hit = prior.get(metric)
+            better = _ledger_higher_is_better(metric)
+            if (
+                hit is None
+                or (better and value > hit[0])
+                or (not better and value < hit[0])
+            ):
+                prior[metric] = (value, name)
+    results: List[GateResult] = []
+    for metric in sorted(set(fresh) | set(prior)):
+        fresh_line = fresh.get(metric)
+        fresh_value = fresh_line.get("value") if fresh_line else None
+        hit = prior.get(metric)
+        if hit is None or not isinstance(fresh_value, (int, float)):
+            results.append(
+                GateResult(
+                    metric,
+                    backend,
+                    "info",
+                    fresh_value,
+                    hit[0] if hit else None,
+                    hit[1] if hit else "-",
+                    None,
+                    note=(
+                        "no prior ledger evidence on this backend"
+                        if hit is None
+                        else "config carried no ledger block this run"
+                    ),
+                )
+            )
+            continue
+        prior_value, source = hit
+        better = _ledger_higher_is_better(metric)
+        if prior_value == 0:
+            change = 0.0
+        elif better:
+            change = (prior_value - fresh_value) / abs(prior_value) * 100.0
+        else:
+            change = (fresh_value - prior_value) / abs(prior_value) * 100.0
+        if change > fail_pct:
+            status = "fail"
+        elif change > warn_pct:
+            status = "warn"
+        else:
+            status = "pass"
+        results.append(
+            GateResult(
+                metric,
+                backend,
+                status,
+                float(fresh_value),
+                prior_value,
+                source,
+                round(change, 1),
+            )
+        )
+    return results
 
 
 # ---------------------------------------------------------------------------

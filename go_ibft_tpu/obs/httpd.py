@@ -1,22 +1,33 @@
-"""Zero-dependency telemetry HTTP endpoints: /metrics, /healthz, /statusz.
+"""Zero-dependency telemetry HTTP endpoints: /metrics, /healthz, /statusz,
+/profilez.
 
 Stdlib ``http.server`` only, like everything else in this repo — a
 :class:`TelemetryServer` binds a ``ThreadingHTTPServer`` on localhost (or
 a given host) and serves:
 
 * ``GET /metrics``  — Prometheus text exposition of the whole metrics
-  registry (:mod:`go_ibft_tpu.obs.metrics_export`);
+  registry (:mod:`go_ibft_tpu.obs.metrics_export`), cost-ledger families
+  included when the ledger is enabled;
 * ``GET /healthz``  — liveness JSON from the mounted ``health_fn``;
   HTTP 200 when healthy, 503 when not (a wedged runner flips this — the
   probe a fleet orchestrator restarts on);
 * ``GET /statusz``  — operator status JSON from ``status_fn`` (current
   height/round, breaker level, speculation hit rate, cache stats, ring
-  ``dropped`` — whatever the mounting component provides).
+  ``dropped`` — whatever the mounting component provides), plus a
+  ``cost_ledger`` block (dispatch/occupancy/compile totals) whenever the
+  runtime cost ledger is on;
+* ``GET /profilez?seconds=0.5`` — an on-demand ``jax.profiler`` window
+  (:mod:`go_ibft_tpu.obs.devprof`): captures device activity for the
+  given window and returns the trace path + host-clock anchor, ready for
+  ``obs/timeline.py::merge_device_trace``.  409 when a window is already
+  open, 503 when the profiler is unavailable.  The ONLY non-read-only
+  endpoint — it writes a trace file to a temp dir, never touches
+  consensus state.
 
-Endpoints are strictly read-only and default-off: nothing in the hot path
-starts a server; ``ChainRunner.start_telemetry`` (or an embedder) mounts
-one explicitly, and the handler threads only ever read lock-guarded
-snapshots, so a scrape can never block consensus.
+Endpoints are default-off: nothing in the hot path starts a server;
+``ChainRunner.start_telemetry`` (or an embedder) mounts one explicitly,
+and the handler threads only ever read lock-guarded snapshots, so a
+scrape can never block consensus.
 """
 
 from __future__ import annotations
@@ -25,8 +36,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qs
 
 from . import metrics_export
+from . import ledger as cost_ledger
 
 __all__ = ["TelemetryServer"]
 
@@ -41,7 +54,7 @@ class _Handler(BaseHTTPRequestHandler):
     health_fn: Optional[HealthFn] = None
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":
                 body = metrics_export.render_prometheus().encode("utf-8")
@@ -55,7 +68,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(200 if ok else 503, payload)
             elif path == "/statusz":
                 payload = self.status_fn() if self.status_fn is not None else {}
+                payload = dict(payload)
+                # The ledger block rides every mount uniformly (runner,
+                # bench, embedder) — None distinguishes "ledger off" from
+                # "ledger on, nothing recorded".
+                payload.setdefault("cost_ledger", cost_ledger.status())
                 self._reply_json(200, payload)
+            elif path == "/profilez":
+                self._profilez(query)
             else:
                 self._reply_json(404, {"error": "not found", "path": path})
         except Exception as err:  # noqa: BLE001 - a scrape must never crash
@@ -64,6 +84,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(500, {"error": repr(err)})
             except OSError:
                 pass  # client went away mid-error: nothing left to do
+
+    def _profilez(self, query: str) -> None:
+        """On-demand device-profiler window (see module docstring)."""
+        from . import devprof
+
+        params = parse_qs(query)
+        try:
+            seconds = float(params.get("seconds", ["0.5"])[0])
+        except ValueError:
+            self._reply_json(400, {"error": "seconds must be a number"})
+            return
+        result = devprof.capture(seconds)
+        if result.get("ok"):
+            code = 200
+        elif str(result.get("error", "")).startswith("busy"):
+            code = 409
+        else:
+            code = 503
+        self._reply_json(code, result)
 
     def _reply(self, code: int, content_type: str, body: bytes) -> None:
         self.send_response(code)

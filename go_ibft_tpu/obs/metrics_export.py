@@ -128,7 +128,54 @@ def render_prometheus() -> str:
         lines.append(f"{_series(name + '_sum', tag)} {_fmt(hist['sum'])}")
         lines.append(f"{_series(name + '_count', tag)} {hist['count']}")
 
+    _render_ledger(lines, emit_type)
     return "\n".join(lines) + "\n"
+
+
+def _render_ledger(lines: List[str], emit_type) -> None:
+    """Cost-ledger families (ISSUE 14): per-(program, route) dispatch
+    counters + occupancy gauges and per-program compile accumulators.
+    Nothing renders while the ledger is disabled."""
+    from . import ledger as cost_ledger
+
+    snap = cost_ledger.snapshot()
+    if snap is None:
+        return
+
+    def esc(v: str) -> str:
+        return str(v).translate(_LABEL_ESCAPE)
+
+    # Family-major iteration: a family's series must form ONE contiguous
+    # group after its TYPE line (the text-format grouping rule strict
+    # scrapers enforce) — same discipline as the renderers above.
+    rows = snap["dispatches"]
+    labels = [
+        f'program="{esc(row["program"])}",route="{esc(row["route"])}"'
+        for row in rows
+    ]
+    for family, field, kind in (
+        ("go_ibft_ledger_dispatches_total", "dispatches", "counter"),
+        ("go_ibft_ledger_lanes_live_total", "live_lanes", "counter"),
+        ("go_ibft_ledger_lanes_padded_total", "padded_lanes", "counter"),
+        ("go_ibft_ledger_device_ms_total", "device_ms", "counter"),
+        ("go_ibft_ledger_occupancy", "occupancy", "gauge"),
+    ):
+        for row, label in zip(rows, labels):
+            value = row[field]
+            if value is None:
+                continue
+            emit_type(family, kind)
+            lines.append(f"{family}{{{label}}} {_fmt(float(value))}")
+    for family, field in (
+        ("go_ibft_ledger_compiles_total", "count"),
+        ("go_ibft_ledger_compile_ms_total", "ms"),
+    ):
+        for program in sorted(snap["compiles"]):
+            acc = snap["compiles"][program]
+            emit_type(family, "counter")
+            lines.append(
+                f"{family}{{program=\"{esc(program)}\"}} {_fmt(float(acc[field]))}"
+            )
 
 
 def parse_exposition(text: str) -> Dict[str, float]:

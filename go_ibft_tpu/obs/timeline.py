@@ -50,6 +50,7 @@ __all__ = [
     "NodePhases",
     "render_report",
     "to_perfetto",
+    "merge_device_trace",
 ]
 
 # Message-type codes as stamped in net.send/net.recv args (wire enum).
@@ -513,3 +514,79 @@ def to_perfetto(traces: Sequence[TraceFile]) -> dict:
         },
         "traceEvents": events,
     }
+
+
+def merge_device_trace(
+    doc: dict,
+    device_trace_path: str,
+    *,
+    host_anchor_us: Optional[int] = None,
+    keep_python_frames: bool = False,
+) -> dict:
+    """Merge a ``jax.profiler`` Chrome trace into a host trace document.
+
+    ``doc`` is a flight-recorder export (``obs/export.py``) or a
+    :func:`to_perfetto` merge; ``device_trace_path`` is the
+    ``*.trace.json.gz`` a :mod:`go_ibft_tpu.obs.devprof` window produced
+    (plain ``.json`` accepted too).  The device events land as extra
+    process groups (pids above the host ones, each ``process_name``
+    prefixed ``device:``) so one Perfetto load shows consensus phases
+    over host spans over device ops — the cost-ledger drill-down view.
+
+    Clock alignment: device timestamps are relative to the profiler
+    session start; ``host_anchor_us`` (the devprof capture's anchor — the
+    flight recorder's monotonic µs clock read at ``start_trace``) minus
+    the document's ``otherData.clockBaseUs`` rebases them onto the host
+    document's clock.  Without either anchor the device group merges
+    unshifted, ordered internally but not aligned (flagged in
+    ``otherData.deviceTraceAligned``).
+
+    The profiler's Python-frame events (names starting ``$``) duplicate
+    what the flight recorder's spans already show and dominate the file
+    size; they are dropped unless ``keep_python_frames``.  Mutates and
+    returns ``doc``.
+    """
+    import gzip
+
+    opener = gzip.open if device_trace_path.endswith(".gz") else open
+    with opener(device_trace_path, "rt") as fh:
+        device_doc = json.load(fh)
+
+    other = doc.setdefault("otherData", {})
+    base = other.get("clockBaseUs")
+    shift = 0
+    aligned = host_anchor_us is not None and base is not None
+    if aligned:
+        shift = int(host_anchor_us) - int(base)
+
+    events = doc.setdefault("traceEvents", [])
+    pid_base = max((e.get("pid", 0) for e in events), default=0) + 1
+    pid_map: Dict[int, int] = {}
+    merged = 0
+    for e in device_doc.get("traceEvents", []):
+        ph = e.get("ph")
+        pid = e.get("pid", 0)
+        if pid not in pid_map:
+            pid_map[pid] = pid_base + len(pid_map)
+        out = dict(e)
+        out["pid"] = pid_map[pid]
+        if ph == "M":
+            if e.get("name") == "process_name":
+                args = dict(e.get("args", {}))
+                args["name"] = f"device:{args.get('name', pid)}"
+                out["args"] = args
+            events.append(out)
+            continue
+        if ph != "X":
+            continue
+        name = e.get("name", "")
+        if name.startswith("$") and not keep_python_frames:
+            continue
+        out["ts"] = e.get("ts", 0) + shift
+        out.setdefault("cat", "device")
+        events.append(out)
+        merged += 1
+    other["deviceTrace"] = device_trace_path
+    other["deviceTraceAligned"] = aligned
+    other["deviceTraceEvents"] = merged
+    return doc

@@ -42,6 +42,7 @@ from ..crypto import ecdsa as host_ecdsa
 from ..crypto.keccak import keccak256_many
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import IbftMessage
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..utils import metrics
 from ..verify import batch as vbatch
@@ -151,21 +152,35 @@ class CoalescedDispatcher:
 
         with self._warm_lock:
             for bb in lanes:
-                RECOVER_KERNEL(
-                    jnp.zeros((bb, 8), jnp.uint32),
-                    jnp.zeros((bb, 20), jnp.int32),
-                    jnp.zeros((bb, 20), jnp.int32),
-                    jnp.zeros((bb,), jnp.int32),
-                    jnp.zeros((bb, 5), jnp.uint32),
-                    jnp.zeros((table_rows, 5), jnp.uint32),
-                    jnp.zeros((bb,), bool),
-                ).block_until_ready()
-                jax.block_until_ready(
-                    DIGEST_KERNEL(
-                        jnp.zeros((bb, 2, 17, 2), jnp.uint32),
-                        jnp.ones((bb,), jnp.int32),
+                with cost_ledger.dispatch_span(
+                    "ecdsa_recover",
+                    route="warmup",
+                    padded=bb,
+                    kernels=(("ecdsa_recover", RECOVER_KERNEL),),
+                    site="sched/dispatch.py:warmup",
+                ):
+                    RECOVER_KERNEL(
+                        jnp.zeros((bb, 8), jnp.uint32),
+                        jnp.zeros((bb, 20), jnp.int32),
+                        jnp.zeros((bb, 20), jnp.int32),
+                        jnp.zeros((bb,), jnp.int32),
+                        jnp.zeros((bb, 5), jnp.uint32),
+                        jnp.zeros((table_rows, 5), jnp.uint32),
+                        jnp.zeros((bb,), bool),
+                    ).block_until_ready()
+                with cost_ledger.dispatch_span(
+                    "digest_words",
+                    route="warmup",
+                    padded=bb,
+                    kernels=(("digest_words", DIGEST_KERNEL),),
+                    site="sched/dispatch.py:warmup",
+                ):
+                    jax.block_until_ready(
+                        DIGEST_KERNEL(
+                            jnp.zeros((bb, 2, 17, 2), jnp.uint32),
+                            jnp.ones((bb,), jnp.int32),
+                        )
                     )
-                )
 
     def dispatch(
         self,
@@ -198,7 +213,19 @@ class CoalescedDispatcher:
             if route == "device":
                 out = self._device(sender_msgs, seal_lanes, pack_caches or {})
             else:
-                out = self._host(sender_msgs, seal_lanes, pack_caches or {})
+                # Host flushes pad nothing (occupancy 1.0); the device
+                # route records per kernel launch inside _device where
+                # the padded bucket shapes are known.
+                with cost_ledger.dispatch_span(
+                    "ecdsa_recover",
+                    route="host",
+                    live=total,
+                    padded=total,
+                    site="sched/dispatch.py:dispatch",
+                ):
+                    out = self._host(
+                        sender_msgs, seal_lanes, pack_caches or {}
+                    )
         metrics.observe(DISPATCH_MS_KEY, (_time.perf_counter() - t0) * 1e3)
         metrics.observe(DISPATCH_LANES_KEY, float(total))
         return out
@@ -231,31 +258,45 @@ class CoalescedDispatcher:
             table = pack_validator_table(
                 list(dict.fromkeys(m.sender for m in msgs))
             )
-            mask = RECOVER_KERNEL(
-                jnp.asarray(zw),
-                jnp.asarray(r),
-                jnp.asarray(s),
-                jnp.asarray(v),
-                jnp.asarray(claimed),
-                jnp.asarray(table),
-                jnp.asarray(live),
-            )
-            sender_ok = np.asarray(mask)[: len(msgs)]
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="device",
+                live_mask=live,
+                kernels=(("ecdsa_recover", RECOVER_KERNEL),),
+                site="sched/dispatch.py:_device",
+            ):
+                mask = RECOVER_KERNEL(
+                    jnp.asarray(zw),
+                    jnp.asarray(r),
+                    jnp.asarray(s),
+                    jnp.asarray(v),
+                    jnp.asarray(claimed),
+                    jnp.asarray(table),
+                    jnp.asarray(live),
+                )
+                sender_ok = np.asarray(mask)[: len(msgs)]
         if lanes:
             hz, r, s, v, signers, live = pack_seal_lanes(list(lanes))
             table = pack_validator_table(
                 list(dict.fromkeys(seal.signer for _h, seal in lanes))
             )
-            mask = RECOVER_KERNEL(
-                jnp.asarray(hz),
-                jnp.asarray(r),
-                jnp.asarray(s),
-                jnp.asarray(v),
-                jnp.asarray(signers),
-                jnp.asarray(table),
-                jnp.asarray(live),
-            )
-            seal_ok = np.asarray(mask)[: len(lanes)]
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="device",
+                live_mask=live,
+                kernels=(("ecdsa_recover", RECOVER_KERNEL),),
+                site="sched/dispatch.py:_device",
+            ):
+                mask = RECOVER_KERNEL(
+                    jnp.asarray(hz),
+                    jnp.asarray(r),
+                    jnp.asarray(s),
+                    jnp.asarray(v),
+                    jnp.asarray(signers),
+                    jnp.asarray(table),
+                    jnp.asarray(live),
+                )
+                seal_ok = np.asarray(mask)[: len(lanes)]
         return sender_ok, seal_ok
 
     # -- host route ------------------------------------------------------

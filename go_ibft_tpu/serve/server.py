@@ -46,6 +46,7 @@ import numpy as np
 
 from ..core.validator_manager import calculate_quorum
 from ..crypto.backend import proposal_hash_of
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..utils import metrics
 from ..verify.batch import HostBatchVerifier
@@ -314,11 +315,21 @@ class ProofVerifier:
             # One drain for every fresh lane of the whole proof.  The
             # membership source is any-signer, so the height argument
             # only labels the drain — every lane carries its OWN
-            # proposal hash (the verify_seal_lanes shape).
-            mask = np.asarray(
-                self._verifier.verify_seal_lanes([lanes[i] for i in fresh], 0),
-                dtype=bool,
-            )
+            # proposal hash (the verify_seal_lanes shape).  route_tag:
+            # a DIRECT drain records in the cost ledger as
+            # ``serve/<route>``.  With a TenantScheduler attached the
+            # tag intentionally does NOT propagate: the scheduler's
+            # flush thread coalesces lanes from many tenants into ONE
+            # dispatch, so per-consumer attribution of that launch is
+            # undefined by construction — scheduled serve work shows up
+            # under the scheduler's own route like every other tenant's.
+            with cost_ledger.route_tag("serve"):
+                mask = np.asarray(
+                    self._verifier.verify_seal_lanes(
+                        [lanes[i] for i in fresh], 0
+                    ),
+                    dtype=bool,
+                )
             for j, i in enumerate(fresh):
                 sig_ok[i] = mask[j]
             self.sig_cache.store_batch([keys[i] for i in fresh], mask)
@@ -357,10 +368,13 @@ class ProofVerifier:
         with trace.span(
             "serve.cert_verify", heights=len(cert_entries)
         ):
-            mask = np.asarray(
-                certifier.verify_many([e.cert for e in cert_entries]),
-                dtype=bool,
-            )
+            # Ledger attribution: the batched multi-pairing this issues
+            # records as ``serve/<route>`` (see _sig_validity).
+            with cost_ledger.route_tag("serve"):
+                mask = np.asarray(
+                    certifier.verify_many([e.cert for e in cert_entries]),
+                    dtype=bool,
+                )
         for entry, ok in zip(cert_entries, mask):
             if not bool(ok):
                 raise ProofError(

@@ -67,6 +67,7 @@ import numpy as np
 
 from ..crypto import bls as hbls
 from ..crypto.keccak import keccak256
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..utils import metrics
 from .bls import PAIRING_EQS_KEY, aggregate_check, encode_seal
@@ -167,10 +168,19 @@ def _merge_g2_groups_device(groups: List[list]) -> list:
     ]
     metrics.inc_counter(MERGE_DISPATCHES_KEY)
     metrics.inc_counter(MERGE_POINTS_KEY, int(live.sum()))
-    limbs, inf = dev.g2_merge_tree(*args, jnp.asarray(live))
-    return dev.unpack_g2_points(np.asarray(limbs), np.asarray(inf))[
-        : len(groups)
-    ]
+    # Ledger occupancy over point SLOTS (g x v): merge padding wastes
+    # both dead groups and dead points within a group.
+    with cost_ledger.dispatch_span(
+        "bls_g2_merge_tree",
+        route="device",
+        live_mask=live,
+        kernels=(("bls_g2_merge_tree", dev.g2_merge_tree),),
+        site="verify/aggregate.py:_merge_g2_groups_device",
+    ):
+        limbs, inf = dev.g2_merge_tree(*args, jnp.asarray(live))
+        return dev.unpack_g2_points(np.asarray(limbs), np.asarray(inf))[
+            : len(groups)
+        ]
 
 
 def _merge_g1_groups_device(groups: List[list]) -> list:
@@ -193,12 +203,19 @@ def _merge_g1_groups_device(groups: List[list]) -> list:
             live[gi, : len(grp)] = [p is not None for p in grp]
     metrics.inc_counter(MERGE_DISPATCHES_KEY)
     metrics.inc_counter(MERGE_POINTS_KEY, int(live.sum()))
-    limbs, inf = dev.g1_merge_tree(
-        jnp.asarray(px), jnp.asarray(py), jnp.asarray(live)
-    )
-    return dev.unpack_g1_points(np.asarray(limbs), np.asarray(inf))[
-        : len(groups)
-    ]
+    with cost_ledger.dispatch_span(
+        "bls_g1_merge_tree",
+        route="device",
+        live_mask=live,
+        kernels=(("bls_g1_merge_tree", dev.g1_merge_tree),),
+        site="verify/aggregate.py:_merge_g1_groups_device",
+    ):
+        limbs, inf = dev.g1_merge_tree(
+            jnp.asarray(px), jnp.asarray(py), jnp.asarray(live)
+        )
+        return dev.unpack_g1_points(np.asarray(limbs), np.asarray(inf))[
+            : len(groups)
+        ]
 
 
 class G2MergeTree:
@@ -574,12 +591,21 @@ def _device_batch_check(lanes: Sequence[Lane], mesh=None) -> np.ndarray:
     args, live_idx = _pack_lanes_device(lanes, dp=dp)
     if not live_idx:
         return out
-    if mesh is not None:
-        ok = _mesh_multi_pairing(mesh)(*args)
-    else:
-        ok = dev.multi_pairing_check(*args)
-    metrics.inc_counter(PAIRING_EQS_KEY, len(live_idx))
-    mask = np.asarray(ok, dtype=bool)
+    # args[-1] is the padded lane-live mask — its length is the bucket
+    # the dispatch actually compiled for (occupancy denominator).
+    with cost_ledger.dispatch_span(
+        "bls_multipair_miller",
+        route="mesh" if mesh is not None else "device",
+        live=len(live_idx),
+        padded=int(np.shape(args[-1])[0]),
+        site="verify/aggregate.py:_device_batch_check",
+    ):
+        if mesh is not None:
+            ok = _mesh_multi_pairing(mesh)(*args)
+        else:
+            ok = dev.multi_pairing_check(*args)
+        metrics.inc_counter(PAIRING_EQS_KEY, len(live_idx))
+        mask = np.asarray(ok, dtype=bool)
     for j, i in enumerate(live_idx):
         out[i] = mask[j]
     return out
@@ -656,16 +682,35 @@ def multi_aggregate_check(
     with trace.span("verify.multipair", lanes=len(lanes), route=route):
         if not lanes:
             return np.zeros(0, dtype=bool)
+        # Host/python lanes are never padded, so occupancy is 1.0 by
+        # construction; the device/mesh routes record inside
+        # _device_batch_check where the padded bucket is known.  ONE
+        # ledger program family either way — the route says which engine
+        # served the lanes, the program keys the attribution.
         if route == "python":
-            return np.asarray(
-                [
-                    aggregate_check(phash, points, pubkeys)
-                    for phash, points, pubkeys in lanes
-                ],
-                dtype=bool,
-            )
+            with cost_ledger.dispatch_span(
+                "bls_multipair_miller",
+                route="python",
+                live=len(lanes),
+                padded=len(lanes),
+                site="verify/aggregate.py:multi_aggregate_check",
+            ):
+                return np.asarray(
+                    [
+                        aggregate_check(phash, points, pubkeys)
+                        for phash, points, pubkeys in lanes
+                    ],
+                    dtype=bool,
+                )
         if route == "host":
-            return _host_batch_check(lanes)
+            with cost_ledger.dispatch_span(
+                "bls_multipair_miller",
+                route="host",
+                live=len(lanes),
+                padded=len(lanes),
+                site="verify/aggregate.py:multi_aggregate_check",
+            ):
+                return _host_batch_check(lanes)
         if route == "device":
             return _device_batch_check(lanes)
         if route == "mesh":

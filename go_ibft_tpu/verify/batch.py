@@ -31,6 +31,7 @@ import numpy as np
 
 from ..core.validator_manager import calculate_quorum
 from ..crypto import ecdsa as host_ecdsa
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..crypto.keccak import keccak256, keccak256_many
 from ..messages.helpers import CommittedSeal
@@ -240,11 +241,20 @@ class HostBatchVerifier:
                     r, s, v = split_signature(msg.signature)
                     digest = keccak256(msg.encode(include_signature=False))
                     prepared.append((i, msg, digest, r, s, v))
-            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
-                recovered = [
-                    (i, msg, self._recover(digest, r, s, v))
-                    for i, msg, digest, r, s, v in prepared
-                ]
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="host",
+                live=len(prepared),
+                padded=len(prepared),
+                site="verify/batch.py:HostBatchVerifier.verify_senders",
+            ):
+                with trace.span(
+                    "verify.dispatch", route="host", lanes=len(prepared)
+                ):
+                    recovered = [
+                        (i, msg, self._recover(digest, r, s, v))
+                        for i, msg, digest, r, s, v in prepared
+                    ]
             with trace.span("verify.device_wait", route="host"):
                 pass  # nothing in flight on the synchronous route
             with trace.span("verify.quorum", lanes=len(recovered)):
@@ -284,11 +294,20 @@ class HostBatchVerifier:
                     ):
                         continue
                     prepared.append((i, seal, *split_signature(seal.signature)))
-            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
-                recovered = [
-                    (i, seal, self._recover(proposal_hash, r, s, v))
-                    for i, seal, r, s, v in prepared
-                ]
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="host",
+                live=len(prepared),
+                padded=len(prepared),
+                site="verify/batch.py:HostBatchVerifier.verify_committed_seals",
+            ):
+                with trace.span(
+                    "verify.dispatch", route="host", lanes=len(prepared)
+                ):
+                    recovered = [
+                        (i, seal, self._recover(proposal_hash, r, s, v))
+                        for i, seal, r, s, v in prepared
+                    ]
             with trace.span("verify.device_wait", route="host"):
                 pass  # nothing in flight on the synchronous route
             with trace.span("verify.quorum", lanes=len(recovered)):
@@ -335,11 +354,20 @@ class HostBatchVerifier:
                     prepared.append(
                         (i, proposal_hash, seal, *split_signature(seal.signature))
                     )
-            with trace.span("verify.dispatch", route="host", lanes=len(prepared)):
-                recovered = [
-                    (i, seal, self._recover(proposal_hash, r, s, v))
-                    for i, proposal_hash, seal, r, s, v in prepared
-                ]
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="host",
+                live=len(prepared),
+                padded=len(prepared),
+                site="verify/batch.py:HostBatchVerifier.verify_seal_lanes",
+            ):
+                with trace.span(
+                    "verify.dispatch", route="host", lanes=len(prepared)
+                ):
+                    recovered = [
+                        (i, seal, self._recover(proposal_hash, r, s, v))
+                        for i, proposal_hash, seal, r, s, v in prepared
+                    ]
             with trace.span("verify.device_wait", route="host"):
                 pass  # nothing in flight on the synchronous route
             with trace.span("verify.quorum", lanes=len(recovered)):
@@ -418,6 +446,11 @@ class HostBatchVerifier:
         metrics.inc_counter(EARLY_EXIT_DRAINS_KEY)
         if skipped:
             metrics.inc_counter(EARLY_EXIT_SKIPPED_KEY, skipped)
+        # Lane counts are only known at exit (the drain stops at quorum),
+        # so the ledger record lands here rather than via a span.
+        cost_ledger.record_dispatch(
+            "ecdsa_recover", "host", live=done, padded=done
+        )
         if t0 is not None:
             metrics.observe_fixed(
                 VERIFY_DRAIN_MS_KEY + ("host",),
@@ -896,7 +929,15 @@ def pack_sender_digest_rows(
         cache_payloads=payloads,
         cache_hits=hits,
     )
-    zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
+    with cost_ledger.dispatch_span(
+        "digest_words",
+        route="device",
+        live_mask=live,
+        kernels=(("digest_words", _digest_kernel),),
+        block=False,
+        site="verify/batch.py:pack_sender_digest_rows",
+    ):
+        zw = _digest_kernel(jnp.asarray(blocks), jnp.asarray(counts))
     if big:
         zw = np.array(zw)  # writable host copy (np.asarray can be RO)
         digests = keccak256_many([payloads[i] for i in big])
@@ -985,17 +1026,18 @@ class DeviceBatchVerifier:
         pay only a cache load.
         """
         for bb in lanes:
-            _recover_kernel(
-                jnp.zeros((bb, 8), jnp.uint32),
-                jnp.zeros((bb, 20), jnp.int32),
-                jnp.zeros((bb, 20), jnp.int32),
-                jnp.zeros((bb,), jnp.int32),
-                jnp.zeros((bb, 5), jnp.uint32),
-                jnp.zeros((table_rows, 5), jnp.uint32),
-                jnp.zeros((bb,), bool),
-            ).block_until_ready()
-            jax.block_until_ready(
-                _certify_kernel(
+            # route="warmup": startup compiles must not pollute the
+            # production routes' occupancy, but their compile events ARE
+            # the cost the ledger exists to measure (the AOT-manifest
+            # baseline of ROADMAP item 5).
+            with cost_ledger.dispatch_span(
+                "ecdsa_recover",
+                route="warmup",
+                padded=bb,
+                kernels=(("ecdsa_recover", _recover_kernel),),
+                site="verify/batch.py:warmup",
+            ):
+                _recover_kernel(
                     jnp.zeros((bb, 8), jnp.uint32),
                     jnp.zeros((bb, 20), jnp.int32),
                     jnp.zeros((bb, 20), jnp.int32),
@@ -1003,17 +1045,41 @@ class DeviceBatchVerifier:
                     jnp.zeros((bb, 5), jnp.uint32),
                     jnp.zeros((table_rows, 5), jnp.uint32),
                     jnp.zeros((bb,), bool),
-                    jnp.zeros((table_rows,), jnp.int32),
-                    jnp.zeros((table_rows,), jnp.int32),
-                    jnp.int32(1),
-                    jnp.int32(0),
-                )
-            )
-            for nb in blocks:
-                _digest_kernel(
-                    jnp.zeros((bb, nb, 17, 2), jnp.uint32),
-                    jnp.ones((bb,), jnp.int32),
                 ).block_until_ready()
+            with cost_ledger.dispatch_span(
+                "quorum_certify",
+                route="warmup",
+                padded=bb,
+                kernels=(("quorum_certify", _certify_kernel),),
+                site="verify/batch.py:warmup",
+            ):
+                jax.block_until_ready(
+                    _certify_kernel(
+                        jnp.zeros((bb, 8), jnp.uint32),
+                        jnp.zeros((bb, 20), jnp.int32),
+                        jnp.zeros((bb, 20), jnp.int32),
+                        jnp.zeros((bb,), jnp.int32),
+                        jnp.zeros((bb, 5), jnp.uint32),
+                        jnp.zeros((table_rows, 5), jnp.uint32),
+                        jnp.zeros((bb,), bool),
+                        jnp.zeros((table_rows,), jnp.int32),
+                        jnp.zeros((table_rows,), jnp.int32),
+                        jnp.int32(1),
+                        jnp.int32(0),
+                    )
+                )
+            for nb in blocks:
+                with cost_ledger.dispatch_span(
+                    "digest_words",
+                    route="warmup",
+                    padded=bb,
+                    kernels=(("digest_words", _digest_kernel),),
+                    site="verify/batch.py:warmup",
+                ):
+                    _digest_kernel(
+                        jnp.zeros((bb, nb, 17, 2), jnp.uint32),
+                        jnp.ones((bb,), jnp.int32),
+                    ).block_until_ready()
 
     # -- validator table management ------------------------------------
 
@@ -1127,6 +1193,12 @@ class DeviceBatchVerifier:
         device count so every shard gets an identical local shape."""
         return 0
 
+    def _program_of(self, quorum_args) -> str:
+        """Cost-ledger program identity for one dispatch (the
+        compile-budget family names — the mesh subclass renames the
+        mask-only program to its sharded twin)."""
+        return "ecdsa_recover" if quorum_args is None else "quorum_certify"
+
     def _dispatch_async(self, inputs, table, quorum_args):
         """Queue the recover (mask-only) or certify (mask+quorum) kernel.
 
@@ -1136,30 +1208,39 @@ class DeviceBatchVerifier:
         blocking — JAX async dispatch lets the caller pack the next batch
         while this one executes (:mod:`go_ibft_tpu.verify.pipeline`).
         """
-        with trace.span("verify.dispatch", route="device"):
-            zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
-            if quorum_args is None:
-                return (
-                    _recover_kernel(
-                        zw, r, s, v, claimed, jnp.asarray(table), live
-                    ),
-                    None,
+        kernel = _recover_kernel if quorum_args is None else _certify_kernel
+        with cost_ledger.dispatch_span(
+            self._program_of(quorum_args),
+            route=self._route,
+            live_mask=inputs[5],
+            kernels=((self._program_of(quorum_args), kernel),),
+            block=False,
+            site="verify/batch.py:_dispatch_async",
+        ):
+            with trace.span("verify.dispatch", route="device"):
+                zw, r, s, v, claimed, live = (jnp.asarray(a) for a in inputs)
+                if quorum_args is None:
+                    return (
+                        _recover_kernel(
+                            zw, r, s, v, claimed, jnp.asarray(table), live
+                        ),
+                        None,
+                    )
+                plo, phi, thr = quorum_args
+                mask, reached_dev, _, _ = _certify_kernel(
+                    zw,
+                    r,
+                    s,
+                    v,
+                    claimed,
+                    jnp.asarray(table),
+                    live,
+                    jnp.asarray(plo),
+                    jnp.asarray(phi),
+                    jnp.int32(max(thr, 0) & 0xFFFF),
+                    jnp.int32(max(thr, 0) >> 16),
                 )
-            plo, phi, thr = quorum_args
-            mask, reached_dev, _, _ = _certify_kernel(
-                zw,
-                r,
-                s,
-                v,
-                claimed,
-                jnp.asarray(table),
-                live,
-                jnp.asarray(plo),
-                jnp.asarray(phi),
-                jnp.int32(max(thr, 0) & 0xFFFF),
-                jnp.int32(max(thr, 0) >> 16),
-            )
-            return mask, reached_dev
+                return mask, reached_dev
 
     @staticmethod
     def _readback(handle) -> Tuple[np.ndarray, Optional[bool]]:
@@ -1181,6 +1262,12 @@ class DeviceBatchVerifier:
         dt_ms = (time.perf_counter() - t0) * 1e3
         metrics.observe(("go-ibft", "device", metric), dt_ms)
         metrics.observe_fixed(VERIFY_DRAIN_MS_KEY + ("device",), dt_ms)
+        # The dispatch record itself landed in _dispatch_async (block=False
+        # — queue time only); the synchronous path knows the full
+        # block-until-ready wall, so attribute it here.
+        cost_ledger.add_device_ms(
+            self._program_of(quorum_args), self._route, dt_ms
+        )
         return mask, reached
 
     # Largest payload the device digest path can absorb; one byte is
@@ -1353,28 +1440,36 @@ class DeviceBatchVerifier:
                 hz, r2, s2, v2, signers, live2 = pack_seal_batch(
                     proposal_hash, [seals[i] for i in sidx], pad_lanes=lanes
                 )
-            with trace.span("verify.dispatch", route="device"):
-                mask, p_reached, s_reached = _round_kernel(
-                    jnp.concatenate([jnp.asarray(zw1), jnp.asarray(hz)], axis=0),
-                    jnp.concatenate([jnp.asarray(r1), jnp.asarray(r2)], axis=0),
-                    jnp.concatenate([jnp.asarray(s1), jnp.asarray(s2)], axis=0),
-                    jnp.concatenate([jnp.asarray(v1), jnp.asarray(v2)], axis=0),
-                    jnp.concatenate(
-                        [jnp.asarray(senders), jnp.asarray(signers)], axis=0
-                    ),
-                    jnp.asarray(table),
-                    jnp.concatenate(
-                        [jnp.asarray(live1), jnp.asarray(live2)], axis=0
-                    ),
-                    jnp.asarray(plo),
-                    jnp.asarray(phi),
-                    jnp.int32(max(p_thr, 0) & 0xFFFF),
-                    jnp.int32(max(p_thr, 0) >> 16),
-                    jnp.int32(max(seal_thr, 0) & 0xFFFF),
-                    jnp.int32(max(seal_thr, 0) >> 16),
-                )
-            with trace.span("verify.device_wait", route="device"):
-                mask = np.asarray(mask)
+            with cost_ledger.dispatch_span(
+                "round_certify",
+                route=self._route,
+                live=len(midx) + len(sidx),
+                padded=2 * lanes,
+                kernels=(("round_certify", _round_kernel),),
+                site="verify/batch.py:certify_round",
+            ):
+                with trace.span("verify.dispatch", route="device"):
+                    mask, p_reached, s_reached = _round_kernel(
+                        jnp.concatenate([jnp.asarray(zw1), jnp.asarray(hz)], axis=0),
+                        jnp.concatenate([jnp.asarray(r1), jnp.asarray(r2)], axis=0),
+                        jnp.concatenate([jnp.asarray(s1), jnp.asarray(s2)], axis=0),
+                        jnp.concatenate([jnp.asarray(v1), jnp.asarray(v2)], axis=0),
+                        jnp.concatenate(
+                            [jnp.asarray(senders), jnp.asarray(signers)], axis=0
+                        ),
+                        jnp.asarray(table),
+                        jnp.concatenate(
+                            [jnp.asarray(live1), jnp.asarray(live2)], axis=0
+                        ),
+                        jnp.asarray(plo),
+                        jnp.asarray(phi),
+                        jnp.int32(max(p_thr, 0) & 0xFFFF),
+                        jnp.int32(max(p_thr, 0) >> 16),
+                        jnp.int32(max(seal_thr, 0) & 0xFFFF),
+                        jnp.int32(max(seal_thr, 0) >> 16),
+                    )
+                with trace.span("verify.device_wait", route="device"):
+                    mask = np.asarray(mask)
             with trace.span("verify.quorum", route="device-fused"):
                 sender_mask[np.asarray(midx)] = mask[: len(midx)]
                 seal_mask[np.asarray(sidx)] = mask[lanes : lanes + len(sidx)]
@@ -1398,7 +1493,13 @@ class DeviceBatchVerifier:
         per chunk.  Returns ``[(item, mask), ...]`` in item order.
         """
         t0 = time.perf_counter()
-        report = VerifyPipeline(depth=2).run(
+        # ledger_key: the pipeline attributes each chunk's readback wait
+        # to the mask program (the dispatch records landed per chunk in
+        # _dispatch_async; the wait is the only timing the async path
+        # cannot observe itself).
+        report = VerifyPipeline(
+            depth=2, ledger_key=(self._program_of(None), self._route)
+        ).run(
             items,
             pack,
             dispatch=lambda p: (p[0], self._dispatch_async(p[1], p[2], None)),

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..crypto import bls as hbls
 from ..messages.helpers import CommittedSeal
+from ..obs import ledger as cost_ledger
 from ..utils import metrics
 
 BLS_SEAL_BYTES = 192
@@ -142,10 +143,12 @@ def _aggregate_check_device(proposal_hash, points, pubkeys) -> bool:
         jnp.asarray(live),
     )
     out = bool(np.asarray(ok))
-    metrics.observe(
-        ("go-ibft", "device", "bls_aggregate_ms"),
-        (time.perf_counter() - t0) * 1e3,
-    )
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    metrics.observe(("go-ibft", "device", "bls_aggregate_ms"), dt_ms)
+    # The dispatch record landed inside aggregate_verify_commit
+    # (block=False — it returns a device future); THIS is the seam that
+    # blocks on the verdict, so it attributes the pairing's wall time.
+    cost_ledger.add_device_ms("bls_aggregate_verify", "device", dt_ms)
     return out
 
 

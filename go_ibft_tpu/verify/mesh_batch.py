@@ -57,6 +57,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import IbftMessage
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..ops import quorum
 from ..ops import secp256k1 as sec
@@ -193,6 +194,14 @@ class MeshBatchVerifier(DeviceBatchVerifier):
 
     # -- dispatch -------------------------------------------------------
 
+    def _program_of(self, quorum_args) -> str:
+        """The sharded mask program has its own compile-budget family
+        (``mesh_verify_mask_8l_dp*`` pins); fused dispatches delegate to
+        the parent's single-chip names."""
+        if self.mesh is not None and quorum_args is None:
+            return "mesh_verify_mask"
+        return super()._program_of(quorum_args)
+
     def _dispatch_async(self, inputs, table, quorum_args):
         """Queue one sharded mask dispatch (mask-only route).
 
@@ -204,22 +213,30 @@ class MeshBatchVerifier(DeviceBatchVerifier):
             return super()._dispatch_async(inputs, table, quorum_args)
         zw, r, s, v, claimed, live = inputs
         lanes = int(np.shape(live)[0])
-        with trace.span(
-            "verify.shard",
-            devices=self.dp,
-            lanes=lanes,
-            lanes_per_device=lanes // self.dp,
+        with cost_ledger.dispatch_span(
+            "mesh_verify_mask",
+            route=self._route,
+            live_mask=live,
+            kernels=(("mesh_verify_mask", self._mask_kernel),),
+            block=False,
+            site="verify/mesh_batch.py:_dispatch_async",
         ):
-            with trace.span("verify.dispatch", route="mesh"):
-                mask = self._mask_kernel(
-                    jnp.asarray(zw),
-                    jnp.asarray(r),
-                    jnp.asarray(s),
-                    jnp.asarray(v),
-                    jnp.asarray(claimed),
-                    table,
-                    jnp.asarray(live),
-                )
+            with trace.span(
+                "verify.shard",
+                devices=self.dp,
+                lanes=lanes,
+                lanes_per_device=lanes // self.dp,
+            ):
+                with trace.span("verify.dispatch", route="mesh"):
+                    mask = self._mask_kernel(
+                        jnp.asarray(zw),
+                        jnp.asarray(r),
+                        jnp.asarray(s),
+                        jnp.asarray(v),
+                        jnp.asarray(claimed),
+                        table,
+                        jnp.asarray(live),
+                    )
         return mask, None
 
     def warmup(
@@ -237,18 +254,25 @@ class MeshBatchVerifier(DeviceBatchVerifier):
         nl = sec.FIELD.nlimbs
         for bb in lanes:
             g = _bucket(bb, _BATCH_BUCKETS) * self.dp
-            self._mask_kernel(
-                jnp.zeros((g, 8), jnp.uint32),
-                jnp.zeros((g, nl), jnp.int32),
-                jnp.zeros((g, nl), jnp.int32),
-                jnp.zeros((g,), jnp.int32),
-                jnp.zeros((g, 5), jnp.uint32),
-                jax.device_put(
-                    np.zeros((table_rows, 5), np.uint32),
-                    NamedSharding(self.mesh, P()),
-                ),
-                jnp.zeros((g,), bool),
-            ).block_until_ready()
+            with cost_ledger.dispatch_span(
+                "mesh_verify_mask",
+                route="warmup",
+                padded=g,
+                kernels=(("mesh_verify_mask", self._mask_kernel),),
+                site="verify/mesh_batch.py:warmup",
+            ):
+                self._mask_kernel(
+                    jnp.zeros((g, 8), jnp.uint32),
+                    jnp.zeros((g, nl), jnp.int32),
+                    jnp.zeros((g, nl), jnp.int32),
+                    jnp.zeros((g,), jnp.int32),
+                    jnp.zeros((g, 5), jnp.uint32),
+                    jax.device_put(
+                        np.zeros((table_rows, 5), np.uint32),
+                        NamedSharding(self.mesh, P()),
+                    ),
+                    jnp.zeros((g,), bool),
+                ).block_until_ready()
 
     # -- fused certify: sharded mask + host-int quorum reduce ------------
 

@@ -51,6 +51,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import ledger as cost_ledger
 from ..obs import trace
 from ..utils import metrics
 
@@ -244,10 +245,19 @@ class VerifyPipeline:
     handle's work is done.
     """
 
-    def __init__(self, depth: int = 2):
+    def __init__(
+        self,
+        depth: int = 2,
+        ledger_key: Optional[Tuple[str, str]] = None,
+    ):
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self.depth = depth
+        # (program, route) for the cost ledger: the async dispatch seam
+        # records the launch but cannot see its block-until-ready wall —
+        # the pipeline owns the readback wait, so it attributes that leg
+        # (no-op while the ledger is disabled).
+        self.ledger_key = ledger_key
 
     def run(
         self,
@@ -276,6 +286,10 @@ class VerifyPipeline:
             dt = time.perf_counter() - t0
             wait_s += dt
             metrics.observe(READBACK_WAIT_MS_KEY, dt * 1e3)
+            if self.ledger_key is not None:
+                cost_ledger.add_device_ms(
+                    self.ledger_key[0], self.ledger_key[1], dt * 1e3
+                )
 
         try:
             for i, item in enumerate(items):

@@ -127,6 +127,18 @@ def _programs() -> dict:
     merge_live = jnp.zeros((128,), bool)
     mm = jnp.zeros((2, 8, fe30), jnp.int32)
 
+    # ISSUE 14: the cost ledger attributes every dispatch into THIS
+    # registry's family names (shape suffix stripped) — so every program
+    # family a seam records must be pinned here, or cost_report's
+    # attribution check reads a correct run as unattributed.  That adds
+    # the two small families that were previously unpinned: the keccak
+    # digest pack program and the G1 merge tree (the G2 twin was already
+    # pinned).  Both are cheap to lower; pinning them also ratchets
+    # their (small) trace sizes like everything else.
+    from go_ibft_tpu.ops.bls12_381 import g1_merge_tree
+
+    merge_g1 = jnp.zeros((128, fe30), jnp.int32)
+
     out = {
         "bls_aggregate_verify_8v": lines(aggregate_verify_commit, *bls_args),
         "bls_g2_merge_tree_128v": len(
@@ -136,6 +148,12 @@ def _programs() -> dict:
             .as_text()
             .splitlines()
         ),
+        "bls_g1_merge_tree_128v": len(
+            g1_merge_tree.lower(merge_g1, merge_g1, merge_live)
+            .as_text()
+            .splitlines()
+        ),
+        "digest_words_8l": lines(quorum.digest_words, blocks, counts),
         "bls_multipair_miller_8l": len(
             _multi_miller_stage.lower(mm, mm, mm, mm, mm, mm)
             .as_text()

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""Runtime cost report: top programs by device time, waste, compile cost.
+
+The read side of the ISSUE 14 cost ledger.  Sources, in priority order:
+
+* ``--drain`` — run a small in-process host-route drain with the ledger
+  enabled and report the live snapshot (the ``make cost-report`` CI
+  smoke: proves the whole plane — seams, accumulators, attribution —
+  renders end to end without any device compile);
+* ``--snapshot cost_ledger.json`` — the snapshot ``bench.py`` dumps at
+  exit (the acceptance path: report over a real bench run);
+* ``--evidence bench_evidence.jsonl`` — per-config ledger blocks stamped
+  on evidence lines (dispatches / occupancy / compiles per config).
+* ``--compile-ledger compile_ledger.jsonl`` — the append-only compile
+  event log (cold-compile duration table per program + call site),
+  printed alongside either of the above when the file exists.
+
+Attribution: dispatch records use the family names of the
+``scripts/compile_budget.py`` registry (shape suffixes stripped), so the
+report maps recorded dispatches onto the pinned program set and prints
+the attributed fraction — ``--check`` fails below ``--min-attribution``
+(default 0.95) and when a pinned family with recorded dispatches is
+missing from the rendered table.
+
+``make cost-report`` runs ``--drain --check``.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "compile_budget.json",
+)
+
+# Shape suffixes the compile-budget registry appends to family names:
+# lane/validator buckets (_8l, _128v, ...) and mesh extents (_dp2 ...).
+_SHAPE_SUFFIX = re.compile(r"(_dp\d+|_\d+[lv])$")
+
+
+def pinned_families(budget_path: str = BUDGET_PATH) -> set:
+    """Compile-budget registry keys with shape suffixes stripped — THE
+    program key space dispatch records attribute into."""
+    try:
+        with open(budget_path) as fh:
+            snapshot = json.load(fh)
+    except (OSError, ValueError):
+        return set()
+    families = set()
+    for key in snapshot:
+        if key.startswith("_"):
+            continue
+        family = key
+        while True:
+            stripped = _SHAPE_SUFFIX.sub("", family)
+            if stripped == family:
+                break
+            family = stripped
+        families.add(family)
+    return families
+
+
+def _table(headers, rows) -> str:
+    rows = [tuple(str(c) for c in row) for row in rows]
+    all_rows = [tuple(headers)] + rows
+    widths = [max(len(r[i]) for r in all_rows) for i in range(len(headers))]
+    out = []
+    for i, row in enumerate(all_rows):
+        out.append("  ".join(c.ljust(widths[j]) for j, c in enumerate(row)))
+        if i == 0:
+            out.append("-" * len(out[0]))
+    return "\n".join(out)
+
+
+def render_snapshot(snap: dict, *, top: int = 20, families=None) -> str:
+    """The per-program report over one ledger snapshot."""
+    families = pinned_families() if families is None else families
+    rows = snap.get("dispatches", [])
+    lines = []
+
+    lines.append(f"== top {min(top, len(rows))} programs by device time ==")
+    table_rows = []
+    for row in rows[:top]:
+        waste = row["padded_lanes"] - row["live_lanes"]
+        table_rows.append(
+            (
+                row["program"],
+                row["route"],
+                row["dispatches"],
+                row["live_lanes"],
+                row["padded_lanes"],
+                "-" if row["occupancy"] is None else f"{row['occupancy']:.3f}",
+                waste,
+                f"{row['device_ms']:.1f}",
+                "yes" if row["program"] in families else "NO",
+            )
+        )
+    lines.append(
+        _table(
+            (
+                "program",
+                "route",
+                "dispatches",
+                "live",
+                "padded",
+                "occupancy",
+                "waste",
+                "device_ms",
+                "pinned",
+            ),
+            table_rows,
+        )
+    )
+
+    total = sum(r["dispatches"] for r in rows)
+    attributed = sum(
+        r["dispatches"] for r in rows if r["program"] in families
+    )
+    fraction = attributed / total if total else None
+    lines.append("")
+    lines.append(
+        "attribution: "
+        + (
+            f"{attributed}/{total} dispatches "
+            f"({fraction:.1%}) map to pinned compile-budget families"
+            if total
+            else "no dispatches recorded"
+        )
+    )
+    unpinned = sorted(
+        {r["program"] for r in rows if r["program"] not in families}
+    )
+    if unpinned:
+        lines.append(f"unpinned programs: {', '.join(unpinned)}")
+    if snap.get("overflowed"):
+        lines.append(
+            f"WARNING: {snap['overflowed']} records landed in the overflow "
+            "bucket (program key space exceeded the ledger cap)"
+        )
+
+    compiles = snap.get("compiles", {})
+    if compiles:
+        lines.append("")
+        lines.append("== compile cost (per program) ==")
+        lines.append(
+            _table(
+                ("program", "compiles", "compile_ms"),
+                [
+                    (name, acc["count"], f"{acc['ms']:.1f}")
+                    for name, acc in sorted(
+                        compiles.items(), key=lambda kv: -kv[1]["ms"]
+                    )
+                ],
+            )
+        )
+    return "\n".join(lines)
+
+
+def render_compile_ledger(path: str, *, top: int = 30) -> str:
+    """Cold-compile duration table from the append-only event log."""
+    events = []
+    try:
+        with open(path) as fh:
+            for raw in fh:
+                raw = raw.strip()
+                if not raw.startswith("{"):
+                    continue
+                try:
+                    event = json.loads(raw)
+                except ValueError:
+                    continue
+                if "program" in event and "ms" in event:
+                    events.append(event)
+    except OSError:
+        return f"(no compile ledger at {path!r})"
+    if not events:
+        return f"(compile ledger {path!r} holds no events)"
+    events.sort(key=lambda e: -e["ms"])
+    lines = [
+        f"== compile events in {path} — append-only across runs "
+        f"({len(events)} total, top {min(top, len(events))} by duration) =="
+    ]
+    lines.append(
+        _table(
+            ("program", "ms", "shared", "site"),
+            [
+                (
+                    e["program"],
+                    f"{e['ms']:.1f}",
+                    e.get("shared_span", 1),
+                    e.get("site", ""),
+                )
+                for e in events[:top]
+            ],
+        )
+    )
+    return "\n".join(lines)
+
+
+def render_evidence(path: str) -> str:
+    """Per-config ledger blocks off an evidence JSONL."""
+    from go_ibft_tpu.obs import gates
+
+    try:
+        lines_in = gates.parse_artifact(path)
+    except OSError as err:
+        return f"(cannot read evidence {path!r}: {err})"
+    rows = []
+    for line in lines_in:
+        block = line.get("ledger")
+        if not isinstance(block, dict):
+            continue
+        rows.append(
+            (
+                line.get("metric"),
+                block.get("dispatches"),
+                "-"
+                if block.get("occupancy") is None
+                else f"{block['occupancy']:.3f}",
+                block.get("device_ms"),
+                block.get("compiles"),
+                block.get("compile_ms"),
+            )
+        )
+    if not rows:
+        return f"(no ledger blocks in {path!r})"
+    out = ["== per-config ledger blocks (evidence deltas) =="]
+    out.append(
+        _table(
+            ("config", "dispatches", "occupancy", "device_ms", "compiles", "compile_ms"),
+            rows,
+        )
+    )
+    return "\n".join(out)
+
+
+def run_drain(compile_log=None) -> dict:
+    """A small host-route drain with the ledger on (the CI smoke).
+
+    Exercises two pinned program families without a single XLA compile:
+    the coalesced host recover flush (``ecdsa_recover``) and the batched
+    host multi-pairing (``bls_multipair_miller``).  Returns the live
+    snapshot.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto import bls as hbls
+    from go_ibft_tpu.crypto.backend import ECDSABackend, proposal_hash_of
+    from go_ibft_tpu.messages.helpers import extract_committed_seal
+    from go_ibft_tpu.messages.wire import Proposal, View
+    from go_ibft_tpu.obs import ledger as cost_ledger
+    from go_ibft_tpu.sched import CoalescedDispatcher
+    from go_ibft_tpu.verify.aggregate import multi_aggregate_check
+
+    cost_ledger.enable(compile_log=compile_log)
+
+    # Coalesced host recover flush over real seals.
+    keys = [PrivateKey.from_seed(b"cost-report-%d" % i) for i in range(4)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    backends = [ECDSABackend(k, src) for k in keys]
+    view = View(height=1, round=0)
+    phash = proposal_hash_of(Proposal(raw_proposal=b"cost report drain", round=0))
+    seals = [
+        extract_committed_seal(b.build_commit_message(phash, view))
+        for b in backends
+    ]
+    sender_ok, seal_ok = CoalescedDispatcher(route="host").dispatch(
+        [], [(phash, seal) for seal in seals]
+    )
+    assert seal_ok.all(), "drain verdicts wrong — refusing to report"
+
+    # Batched host multi-pairing over a real aggregate lane.
+    blk = [hbls.BLSPrivateKey.from_seed(b"cost-report-%d" % i) for i in range(2)]
+    lanes = [
+        (phash, [k.sign(phash) for k in blk], [k.pubkey for k in blk])
+    ] * 2
+    assert multi_aggregate_check(lanes, route="host").all()
+    return cost_ledger.snapshot()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--snapshot", default="cost_ledger.json")
+    parser.add_argument("--compile-ledger", default="compile_ledger.jsonl")
+    parser.add_argument("--evidence", default=None)
+    parser.add_argument(
+        "--drain",
+        action="store_true",
+        help="run a small in-process host drain and report its ledger "
+        "(ignores --snapshot)",
+    )
+    parser.add_argument("--top", type=int, default=20)
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI smoke: fail unless the report renders, every pinned "
+        "family with recorded dispatches appears, and attribution "
+        "meets --min-attribution",
+    )
+    parser.add_argument("--min-attribution", type=float, default=0.95)
+    args = parser.parse_args()
+
+    if args.drain:
+        snap = run_drain(compile_log=args.compile_ledger)
+        source = "in-process drain"
+    else:
+        try:
+            with open(args.snapshot) as fh:
+                snap = json.load(fh)
+        except (OSError, ValueError) as err:
+            print(
+                f"cost_report: cannot read snapshot {args.snapshot!r} "
+                f"({err}); run `python bench.py` (writes cost_ledger.json) "
+                "or use --drain",
+                file=sys.stderr,
+            )
+            return 2
+        source = args.snapshot
+
+    families = pinned_families()
+    # --check asserts every pinned family that ran APPEARS in the
+    # rendered table — so check mode never truncates (a healthy run with
+    # many (program, route) rows must not fail on table length alone).
+    if args.check:
+        args.top = max(args.top, len(snap.get("dispatches", [])))
+    report = render_snapshot(snap, top=args.top, families=families)
+    print(f"cost report — source: {source}")
+    print(report)
+    if os.path.exists(args.compile_ledger):
+        print()
+        print(render_compile_ledger(args.compile_ledger))
+    if args.evidence:
+        print()
+        print(render_evidence(args.evidence))
+
+    if args.check:
+        rows = snap.get("dispatches", [])
+        total = sum(r["dispatches"] for r in rows)
+        if total == 0:
+            print("cost_report --check: FAIL (no dispatches recorded)")
+            return 1
+        ran = {r["program"] for r in rows if r["program"] in families}
+        rendered = {r["program"] for r in rows[: args.top]}
+        missing = [f for f in sorted(ran) if f not in rendered]
+        attributed = sum(
+            r["dispatches"] for r in rows if r["program"] in families
+        )
+        fraction = attributed / total
+        failures = []
+        if missing:
+            failures.append(f"pinned families missing from report: {missing}")
+        if fraction < args.min_attribution:
+            failures.append(
+                f"attribution {fraction:.1%} < {args.min_attribution:.0%}"
+            )
+        if failures:
+            print("cost_report --check: FAIL")
+            for failure in failures:
+                print(f"  - {failure}")
+            return 1
+        print(
+            f"cost_report --check: ok ({len(ran)} pinned families, "
+            f"attribution {fraction:.1%})"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

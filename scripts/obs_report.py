@@ -88,6 +88,22 @@ def main() -> int:
                 )
     print(gates.render_table(results))
     statuses = {r.status for r in results}
+    # Cost-ledger gates (ISSUE 14): per-config dispatch-count growth and
+    # occupancy, from the ledger blocks stamped on evidence lines.
+    # Rendered whenever the fresh artifact carries any; graded against
+    # the best prior round on the same backend with the tighter ledger
+    # thresholds (dispatch counts are near-deterministic per config).
+    ledger_results = gates.gate_ledger_evidence(
+        fresh,
+        args.repo,
+        backend=backend,
+        exclude=(os.path.basename(args.evidence),),
+    )
+    if ledger_results:
+        print()
+        print("cost ledger (per-config dispatches / occupancy):")
+        print(gates.render_table(ledger_results))
+        statuses |= {r.status for r in ledger_results}
     bad = {"fail"} if args.fail_on == "fail" else {"fail", "warn"}
     if args.fail_on != "never" and statuses & bad:
         return 1

@@ -43,14 +43,36 @@ def _sizes() -> tuple:
     return _DEFAULT_SIZES
 
 
-def _stamp(label: str, t0: float) -> None:
-    print(f"[warm] {label}: {time.perf_counter() - t0:.1f}s", flush=True)
+def _stamp(label: str, t0: float, program: str = None) -> None:
+    """Print the step duration; with ``program`` set, ALSO record it to
+    the compile ledger — used ONLY for steps whose kernels this script
+    cannot introspect (the mesh dryrun, the Pallas kernel).  Every other
+    step's true compiles are recorded by the seam-level cache
+    introspection inside the warmup()/entry-point it drives, so stamping
+    those here would double-count each cold build (and record cache
+    LOADS as compiles on a warm persistent cache)."""
+    from go_ibft_tpu.obs import ledger as cost_ledger
+
+    elapsed_s = time.perf_counter() - t0
+    print(f"[warm] {label}: {elapsed_s:.1f}s", flush=True)
+    if program is not None:
+        cost_ledger.record_compile(
+            program,
+            elapsed_s * 1e3,
+            site="scripts/warm_kernels.py (step duration, not introspected)",
+        )
 
 
 def main() -> None:
+    from go_ibft_tpu.obs import ledger as cost_ledger
     from go_ibft_tpu.utils.jaxcache import enable_persistent_cache
 
     enable_persistent_cache()
+    cost_ledger.enable(
+        compile_log=os.environ.get(
+            "GO_IBFT_COMPILE_LEDGER", "compile_ledger.jsonl"
+        )
+    )
 
     import jax.numpy as jnp
 
@@ -74,7 +96,7 @@ def main() -> None:
         from __graft_entry__ import dryrun_multichip
 
         dryrun_multichip(8)
-        _stamp("mesh dryrun programs (8-device (dp, vp))", t0)
+        _stamp("mesh dryrun programs (8-device (dp, vp))", t0, "mesh_quorum_certify")
 
         # MeshBatchVerifier's sharded mask program at the tier-1 test
         # shapes (dp=2 and dp=8, 8 local lanes, 8-row table): the oracle-
@@ -124,9 +146,19 @@ def main() -> None:
     for n in _sizes():
         t0 = time.perf_counter()
         w = build_round_workload(n)
-        quorum_certify(*_prep_args(w))[0].block_until_ready()
-        seal_quorum_certify(*_seal_args(w))[0].block_until_ready()
-        round_certify(*_round_args(w))[0].block_until_ready()
+        # The fused quorum programs are jit objects: the compile watch
+        # records true first compiles (cache loads record nothing).
+        with cost_ledger.compile_watch(
+            (
+                ("quorum_certify", quorum_certify),
+                ("seal_quorum_certify", seal_quorum_certify),
+                ("round_certify", round_certify),
+            ),
+            site="scripts/warm_kernels.py",
+        ):
+            quorum_certify(*_prep_args(w))[0].block_until_ready()
+            seal_quorum_certify(*_seal_args(w))[0].block_until_ready()
+            round_certify(*_round_args(w))[0].block_until_ready()
         _stamp(f"quorum kernels @{n} validators", t0)
 
     t0 = time.perf_counter()
@@ -134,7 +166,7 @@ def main() -> None:
 
     state = jnp.zeros((1, 25, 2), dtype=jnp.uint32)
     keccak_f_pallas(state, interpret=not pallas_supported()).block_until_ready()
-    _stamp("pallas keccak_f (50x128 tile)", t0)
+    _stamp("pallas keccak_f (50x128 tile)", t0, "pallas_keccak_f")
 
     if "--skip-bls" not in sys.argv:
         t0 = time.perf_counter()
@@ -167,29 +199,36 @@ def main() -> None:
             pack_g2_points,
         )
 
+        merge_watch = (
+            ("bls_g2_merge_tree", g2_merge_tree),
+            ("bls_g1_merge_tree", g1_merge_tree),
+        )
         for bucket in (8, 128):
             t0 = time.perf_counter()
             pts = [_hbls.g2_mul(3 + i, _hbls.G2_GEN) for i in range(2)]
             x0, x1, y0, y1 = pack_g2_points(pts + [None] * (bucket - 2))
             live = _np.zeros(bucket, dtype=bool)
             live[:2] = True
-            jnp.asarray(
-                g2_merge_tree(
-                    jnp.asarray(x0),
-                    jnp.asarray(x1),
-                    jnp.asarray(y0),
-                    jnp.asarray(y1),
-                    jnp.asarray(live),
-                )[0]
-            ).block_until_ready()
-            if bucket == 128:
-                g1 = [_hbls.g1_mul(3 + i, _hbls.G1_GEN) for i in range(2)]
-                px, py = pack_g1_points(g1 + [None] * (bucket - 2))
+            with cost_ledger.compile_watch(
+                merge_watch, site="scripts/warm_kernels.py"
+            ):
                 jnp.asarray(
-                    g1_merge_tree(
-                        jnp.asarray(px), jnp.asarray(py), jnp.asarray(live)
+                    g2_merge_tree(
+                        jnp.asarray(x0),
+                        jnp.asarray(x1),
+                        jnp.asarray(y0),
+                        jnp.asarray(y1),
+                        jnp.asarray(live),
                     )[0]
                 ).block_until_ready()
+                if bucket == 128:
+                    g1 = [_hbls.g1_mul(3 + i, _hbls.G1_GEN) for i in range(2)]
+                    px, py = pack_g1_points(g1 + [None] * (bucket - 2))
+                    jnp.asarray(
+                        g1_merge_tree(
+                            jnp.asarray(px), jnp.asarray(py), jnp.asarray(live)
+                        )[0]
+                    ).block_until_ready()
             _stamp(f"g2/g1 merge-tree kernels ({bucket} bucket)", t0)
 
         t0 = time.perf_counter()
@@ -206,6 +245,22 @@ def main() -> None:
         ] * 2
         assert multi_aggregate_check(lanes, route="device").all()
         _stamp("batched multi-pairing (2-lane bucket)", t0)
+
+    # The measured cold-compile (or cache-load) duration table, also
+    # appended per event to compile_ledger.jsonl above — CI's archived
+    # baseline for the ROADMAP-item-5 AOT compile cache.
+    snap = cost_ledger.snapshot()
+    if snap is not None and snap["compiles"]:
+        print("[warm] compile ledger (per program):", flush=True)
+        for name, acc in sorted(
+            snap["compiles"].items(), key=lambda kv: -kv[1]["ms"]
+        ):
+            print(
+                f"[warm]   {name}: {acc['count']} event(s), "
+                f"{acc['ms'] / 1e3:.1f}s total",
+                flush=True,
+            )
+    cost_ledger.disable()
 
 
 if __name__ == "__main__":

@@ -3,7 +3,8 @@
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
 	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
-	serve-bench timeline-smoke slo-gates multipair-bench cost-report
+	serve-bench timeline-smoke slo-gates multipair-bench cost-report \
+	boot-bench boot-check
 
 test:
 	python -m pytest tests/ -q
@@ -79,6 +80,36 @@ multipair-bench:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	GO_IBFT_MULTIPAIR_BENCH=1 GO_IBFT_BENCH_BUDGET_S=900 \
 	python bench.py --multipair-only
+
+# Boot warm-start bench (config #14): restart-to-first-finalized in
+# REAL child processes, cold persistent cache vs warm (>=5x acceptance,
+# zero cold-compile events on the second boot), plus the tenant-churn
+# soak (live add/remove/reconfigure; survivors miss no heights).
+# GO_IBFT_BOOT_BENCH_PROGRAM / GO_IBFT_BOOT_BENCH_CACHED_RUNS scale it.
+boot-bench:
+	JAX_PLATFORMS=cpu GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --boot-only
+
+# Fast second-boot cache proof (CI fast tier, ~15 s): warm the cheap
+# digest family twice against one FRESH temp cache dir.  Run 1 must
+# classify + record the cold compile (GO_IBFT_BOOT_COLD_S lowered under
+# the digest's ~0.4 s compile; GO_IBFT_CACHE_MIN_COMPILE_S=0 persists
+# it past jax's 1 s floor); run 2 must pay zero cold compiles
+# (--assert-warm) AND cost <50% of run 1 per family (scripts/
+# boot_check.py — ratio, not absolute, so runner speed can't flake it).
+boot-check:
+	rm -rf /tmp/go_ibft_boot_check && mkdir -p /tmp/go_ibft_boot_check
+	JAX_PLATFORMS=cpu GO_IBFT_CACHE_DIR=/tmp/go_ibft_boot_check/xla \
+	GO_IBFT_CACHE_MIN_COMPILE_S=0 GO_IBFT_BOOT_COLD_S=0.15 \
+	python scripts/warm_kernels.py --aot-only --programs digest_words_8l \
+		--manifest /tmp/go_ibft_boot_check/m1.json
+	JAX_PLATFORMS=cpu GO_IBFT_CACHE_DIR=/tmp/go_ibft_boot_check/xla \
+	GO_IBFT_CACHE_MIN_COMPILE_S=0 GO_IBFT_BOOT_COLD_S=0.15 \
+	python scripts/warm_kernels.py --aot-only --no-skip --assert-warm \
+		--programs digest_words_8l \
+		--manifest /tmp/go_ibft_boot_check/m2.json
+	python scripts/boot_check.py /tmp/go_ibft_boot_check/m1.json \
+		/tmp/go_ibft_boot_check/m2.json
 
 # Multi-tenant fairness soak: hot + slow chains sharing one scheduler
 # under seeded chaos (tests/test_sched_consensus.py, slow tier included)

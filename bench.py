@@ -2721,6 +2721,279 @@ def config2_headline() -> None:
     _log(line)
 
 
+def config14_boot_warm_start() -> None:
+    """Boot warm-start (config #14): restart-to-first-finalized, cold
+    persistent cache vs warm, plus a live tenant-churn soak.
+
+    Restart legs are REAL process restarts: each leg spawns
+    ``python -m go_ibft_tpu.boot`` (fresh interpreter, fresh jax) against
+    one shared ``GO_IBFT_CACHE_DIR`` that starts empty.  Leg 1 pays the
+    cold XLA compiles and populates the cache; the cached legs must load
+    every warmed program from disk.  Proof is structural, not just
+    faster-wall: each leg writes its own compile ledger
+    (``GO_IBFT_COMPILE_LEDGER``) and the cached legs must show ZERO
+    recorded compile events — ``warm_cold_events`` in the evidence line.
+    The ratio is CPU-measurable (XLA:CPU pays the same cold compile the
+    device would; the cache mechanics are backend-keyed but identical).
+
+    The churn soak then exercises the live-reconfiguration half of the
+    boot story in-process: four chains finalize real heights through one
+    shared :class:`TenantScheduler` while a churn thread repeatedly
+    ``add_tenant``/``remove_tenant``s short-lived tenants (drained, then
+    verified again through the now-stale handle, which must shed to the
+    host oracle) and ``reconfigure``s the dispatcher mid-traffic.
+    Survivors must finalize every height (``missed_heights == 0``) and
+    every churn verdict must match the sequential oracle.
+    """
+    import statistics as _stats
+    import tempfile
+    import threading as _threading
+
+    from go_ibft_tpu.boot.restart import BootLegTimeout, run_boot_leg
+
+    family = os.environ.get("GO_IBFT_BOOT_BENCH_PROGRAM", "ecmul2_base_8l")
+    cached_runs = int(os.environ.get("GO_IBFT_BOOT_BENCH_CACHED_RUNS", "2"))
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def _leg(tag: str, cache_dir: str, tmp: str, timeout_s: float) -> dict:
+        return run_boot_leg(
+            tag,
+            family,
+            cache_dir,
+            os.path.join(tmp, f"compile_ledger_{tag}.jsonl"),
+            timeout_s=timeout_s,
+            cwd=repo_root,
+        )
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="go_ibft_boot_bench_") as tmp:
+            cache_dir = os.path.join(tmp, "xla")
+            cold = _leg(
+                "cold",
+                cache_dir,
+                tmp,
+                min(420.0, max(60.0, _remaining_s() - 60.0)),
+            )
+            assert cold["report"]["cold"] >= 1, (
+                f"cold leg classified no cold compiles: {cold['report']}"
+            )
+            cached = [
+                _leg(f"cached{i}", cache_dir, tmp, 180.0)
+                for i in range(max(1, cached_runs))
+            ]
+    except BootLegTimeout as slow:
+        # A leg that outlives its wall budget is a budget problem, not a
+        # correctness failure: the child was killed before finishing its
+        # cold compile.  Report an honest skip (same shape _guarded
+        # emits) so the configs behind us still run and rc stays 0.
+        _log(
+            {
+                "metric": config14_boot_warm_start.metric,
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "note": (
+                    f"skipped: {slow} with {_remaining_s():.0f}s of "
+                    "budget left (GO_IBFT_BENCH_BUDGET_S)"
+                ),
+            }
+        )
+        return
+
+    warm_cold_events = sum(len(leg["events"]) for leg in cached)
+    warm_cold_classified = sum(leg["report"]["cold"] for leg in cached)
+    boot_cold_ms = cold["report"]["entry_to_first_finalized_ms"]
+    cached_ms = [leg["report"]["entry_to_first_finalized_ms"] for leg in cached]
+    boot_cached_ms = _stats.median(cached_ms)
+    speedup = boot_cold_ms / boot_cached_ms
+    assert warm_cold_events == 0 and warm_cold_classified == 0, (
+        f"second boot paid cold compiles: {warm_cold_classified} classified, "
+        f"{warm_cold_events} ledger events"
+    )
+
+    # --- Tenant-churn soak: survivors never miss a height. -------------
+    import asyncio
+
+    from go_ibft_tpu.bench.workload import build_signed_round
+    from go_ibft_tpu.chain import ChainRunner
+    from go_ibft_tpu.core import IBFT, BatchingIngress
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.sched import TenantScheduler
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    class _Null:
+        def info(self, *a):
+            pass
+
+        debug = error = info
+
+    chains, heights, n = 4, 2, 4
+    sched_route = "host" if _FALLBACK else "auto"
+    sched = TenantScheduler(window_s=0.001, route=sched_route)
+    results: list = []
+    errors: list = []
+    churn = {
+        "added": 0,
+        "removed": 0,
+        "drained": 0,
+        "reconfigures": 0,
+        "stale_sheds": 0,
+        "overlapped_cycles": 0,
+        "dp_seq": [],
+        "verdicts_ok": True,
+    }
+
+    async def _chain_main(chain: int) -> dict:
+        keys = [
+            PrivateKey.from_seed(b"bench-c14-%d-%d" % (chain, i))
+            for i in range(n)
+        ]
+        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        nodes = []
+
+        def gossip(message):
+            for _core, ingress in nodes:
+                ingress.submit(message)
+
+        class _T:
+            def multicast(self, message):
+                gossip(message)
+
+        runners = []
+        for i, key in enumerate(keys):
+            handle = sched.register(
+                f"soak-c{chain}/n{i}", src, chain_id=f"c{chain}"
+            )
+            core = IBFT(_Null(), ECDSABackend(key, src), _T(),
+                        batch_verifier=handle)
+            core.set_base_round_timeout(30.0)
+            nodes.append((core, BatchingIngress(core.add_messages)))
+            runners.append(ChainRunner(core, overlap=False))
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(r.run(until_height=heights) for r in runners)),
+                180,
+            )
+        finally:
+            for core, ingress in nodes:
+                ingress.close()
+                core.messages.close()
+        finalized = min(len(core.backend.inserted) for core, _ in nodes)
+        return {"chain": chain, "finalized": finalized}
+
+    def _one(chain: int) -> None:
+        try:
+            results.append(asyncio.run(_chain_main(chain)))
+        except BaseException as err:  # noqa: BLE001 - surfaced below
+            errors.append(f"chain {chain}: {type(err).__name__}: {err}")
+
+    stop = _threading.Event()
+
+    def _churner() -> None:
+        r = build_signed_round(4, seed=777, corrupt_frac=0.25)
+        keys = [PrivateKey.from_seed(b"bench-777-%d" % j) for j in range(4)]
+        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+        sender_oracle = HostBatchVerifier(src).verify_senders(r.prepares)
+
+        def _check(mask, want) -> None:
+            if not (mask == want).all():
+                churn["verdicts_ok"] = False
+
+        for i in range(40):
+            overlapped = not stop.is_set()
+            tid = f"churn-{i}"
+            handle = sched.add_tenant(tid, src)
+            churn["added"] += 1
+            _check(handle.verify_senders(r.prepares), sender_oracle)
+            _check(
+                handle.verify_committed_seals(r.proposal_hash, r.seals, 1),
+                r.expected_seal_mask,
+            )
+            drained = sched.remove_tenant(tid, timeout_s=10.0)
+            churn["removed"] += 1
+            churn["drained"] += int(drained)
+            # The now-stale handle must shed to the host oracle — same
+            # verdicts, no queueing into a tenant nothing selects.
+            _check(handle.verify_senders(r.prepares), sender_oracle)
+            churn["stale_sheds"] += 1
+            if i % 3 == 2:
+                # Mid-traffic dispatcher swap: dp=2 asks for a 2-shard
+                # mesh (degrades to single-device when only one device
+                # is visible — mesh_context is best-effort); no-arg swap
+                # returns to the plain dispatcher.  Either way in-flight
+                # flushes drain before the swap and survivors continue.
+                desc = sched.reconfigure(dp=2 if (i // 3) % 2 == 0 else None)
+                churn["reconfigures"] += 1
+                churn["dp_seq"].append(desc["new"]["dp"])
+            if overlapped:
+                churn["overlapped_cycles"] += 1
+            if stop.is_set() and churn["reconfigures"] >= 2:
+                break
+            stop.wait(0.1)
+
+    t0 = time.perf_counter()
+    with sched:
+        threads = [
+            _threading.Thread(target=_one, args=(c,)) for c in range(chains)
+        ]
+        churner = _threading.Thread(target=_churner)
+        for t in threads:
+            t.start()
+        churner.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        churner.join()
+    soak_s = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError("; ".join(errors[:3]))
+    missed = sum(max(0, heights - r["finalized"]) for r in results)
+    assert missed == 0, f"survivors missed {missed} heights: {results}"
+    assert churn["verdicts_ok"], "churn-tenant verdicts diverged from oracle"
+    assert churn["removed"] == churn["drained"], (
+        f"{churn['removed'] - churn['drained']} removals timed out undrained"
+    )
+    assert churn["reconfigures"] >= 2
+
+    assert speedup >= 5.0, (
+        f"warm boot only {speedup:.1f}x faster than cold "
+        f"({boot_cold_ms:.0f}ms vs {boot_cached_ms:.0f}ms) — acceptance is 5x"
+    )
+    _log(
+        {
+            "metric": config14_boot_warm_start.metric,
+            "value": round(speedup, 2),
+            "unit": "x",
+            "vs_baseline": round(speedup, 2),
+            "baseline": "same boot against an empty persistent cache",
+            "variant": "cpu-fallback" if _FALLBACK else "device",
+            "program": family,
+            "boot_cold_ms": round(boot_cold_ms, 1),
+            "boot_cached_ms": round(boot_cached_ms, 1),
+            "cached_legs_ms": [round(v, 1) for v in cached_ms],
+            "cold_runs": 1,
+            "cached_runs": len(cached),
+            "cold_compile_events": len(cold["events"]),
+            "warm_cold_events": warm_cold_events,
+            "zero_cold_second_boot": True,
+            "spawn_ms_cold": round(cold["spawn_ms"], 1),
+            "spawn_ms_cached": round(
+                _stats.median(leg["spawn_ms"] for leg in cached), 1
+            ),
+            "chain_ms_cold": cold["report"]["chain_ms"],
+            "chain_ms_cached": cached[0]["report"]["chain_ms"],
+            "soak_elapsed_s": round(soak_s, 2),
+            "missed_heights": 0,
+            "churn": {k: v for k, v in churn.items()},
+            "sched_stats": {
+                k: sched.stats()[k]
+                for k in ("dispatches", "coalesced_requests", "dispatcher")
+            },
+        }
+    )
+
+
 def _guarded(config_fn, failures: list, reserve_s: float = 0.0) -> None:
     """Secondary configs must not take down the headline: report the
     failure as a JSON line and keep going.  The differential smoke and the
@@ -2779,6 +3052,7 @@ config10_multitenant.metric = "multi_tenant_blocks_per_s"
 config11_commit_critical_path.metric = "commit_critical_path_100v"
 config12_proof_serving.metric = "proof_serving_100v"
 config13_multipair.metric = "batched_multipairing_1000c"
+config14_boot_warm_start.metric = "boot_warm_start"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -2806,6 +3080,17 @@ _FALLBACK_SCHEDULE = (
     (config11_commit_critical_path, 95.0),
     (config12_proof_serving, 65.0),
     (config13_multipair, 35.0),
+    # Config #14 pays a real cold XLA compile in a child process
+    # (~60-105 s for ecmul2_base_8l on XLA:CPU) plus cached legs and
+    # the churn soak (~110-170 s total).  Its reserve carries its OWN
+    # cost on top of config #2/#1's 30 s: it runs only with generous
+    # slack (the default 720 s driver budget leaves ~500 s here) and
+    # skips with an honest evidence line under the 480 s
+    # driver-conditions budget, where running would both starve the
+    # happy-path/headline configs behind it (the contract requires
+    # those to MEASURE) and add three minutes of child-process compile
+    # to every contract-suite run.  `--boot-only` bypasses the reserve.
+    (config14_boot_warm_start, 420.0),
     (config2_host_fallback, 30.0),
     (config1_happy_path, 0.0),
 )
@@ -2820,8 +3105,12 @@ _DEVICE_SCHEDULE = (
     (config9_aggregate, 390.0),
     (config10_multitenant, 360.0),
     (config11_commit_critical_path, 350.0),
-    (config12_proof_serving, 320.0),
-    (config13_multipair, 300.0),
+    (config12_proof_serving, 330.0),
+    (config13_multipair, 310.0),
+    # Runs last before the headline: its child-process cold compile is
+    # the most elastic cost on a live chip, and a skip here (tight
+    # budget) still leaves an honest evidence line for the contract.
+    (config14_boot_warm_start, 300.0),
 )
 
 
@@ -2935,6 +3224,16 @@ def main(argv=None) -> None:
         "cold/warm cache, coalesced vs per-client clients, and the "
         "consensus-vs-proof-flood QoS bound on the host route; "
         "GO_IBFT_SERVE_CLIENTS overrides the client count)",
+    )
+    parser.add_argument(
+        "--boot-only",
+        action="store_true",
+        help="run ONLY the boot warm-start config (#14); the rc=0 evidence "
+        "contract scopes to it (the `make boot-bench` entry point — "
+        "restart-to-first-finalized cold vs cached persistent cache in "
+        "child processes, zero-cold-compile second boot, and the "
+        "tenant-churn soak; GO_IBFT_BOOT_BENCH_PROGRAM / "
+        "GO_IBFT_BOOT_BENCH_CACHED_RUNS scale it)",
     )
     args = parser.parse_args(argv)
     from go_ibft_tpu.obs import ledger as cost_ledger
@@ -3107,6 +3406,20 @@ def _run(args) -> None:
         failures = []
         _guarded(config12_proof_serving, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config12_proof_serving.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.boot_only:
+        # Scoped run for `make boot-bench`: only config #14, rc=0 iff its
+        # evidence line landed.  The config gates itself (cold leg must
+        # classify cold compiles, cached legs must record ZERO, churn
+        # survivors must miss no heights) before reporting.
+        failures = []
+        _guarded(config14_boot_warm_start, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config14_boot_warm_start.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

@@ -279,6 +279,18 @@ class ChainRunner:
         )
         return self.height
 
+    def warm_start(self, **kw):
+        """Full warm restore (ISSUE 16): compiled programs + WAL + verdict
+        caches, all BEFORE the first round opens.  Thin delegation to
+        :func:`go_ibft_tpu.boot.warmstart.warm_start` with this runner —
+        keyword arguments (``programs`` / ``manifest`` / ``handle`` /
+        ``sig_cache`` / ``warmups`` ...) pass through; returns its
+        :class:`~go_ibft_tpu.boot.warmstart.WarmStartReport`.  Lazy import
+        so runners that never warm-start pay no boot-package import."""
+        from ..boot.warmstart import warm_start as _warm_start
+
+        return _warm_start(self, **kw)
+
     # -- the height loop -------------------------------------------------
 
     async def run(

@@ -328,41 +328,52 @@ LEDGER_FAIL_PCT = 30.0
 
 
 def ledger_metric_lines(lines: Iterable[dict]) -> List[dict]:
-    """Synthesize gateable metric lines from evidence-line ledger blocks.
+    """Synthesize gateable metric lines from evidence-line sub-fields.
 
     ``<config>.ledger_dispatches`` (lower is better) and
     ``<config>.ledger_occupancy`` (higher is better — the ``/s``-free
-    unit is special-cased in :func:`gate_ledger_evidence`).  Lines
-    without a ledger block (pre-ISSUE-14 artifacts, ledger-off runs)
+    unit is special-cased in :func:`gate_ledger_evidence`) from ledger
+    blocks, plus ``<config>.boot_cold_ms`` / ``<config>.boot_cached_ms``
+    (both lower-better walls) from the boot warm-start config's evidence
+    line — a cached-boot regression fails CI exactly like a throughput
+    regression.  Lines without these fields (pre-ISSUE-14/16 artifacts)
     yield nothing, so old rounds grade ``info``.
     """
     out: List[dict] = []
     for line in lines:
         metric = line.get("metric")
-        block = line.get("ledger")
-        if (
-            metric is None
-            or metric in _NON_CONFIG_METRICS
-            or not isinstance(block, dict)
-        ):
+        if metric is None or metric in _NON_CONFIG_METRICS:
             continue
-        dispatches = block.get("dispatches")
-        if isinstance(dispatches, (int, float)) and dispatches > 0:
-            out.append(
-                {
-                    "metric": f"{metric}.ledger_dispatches",
-                    "value": dispatches,
-                    "unit": "dispatches",
-                    "backend": line.get("backend"),
-                }
-            )
-            occupancy = block.get("occupancy")
-            if isinstance(occupancy, (int, float)):
+        block = line.get("ledger")
+        if isinstance(block, dict):
+            dispatches = block.get("dispatches")
+            if isinstance(dispatches, (int, float)) and dispatches > 0:
                 out.append(
                     {
-                        "metric": f"{metric}.ledger_occupancy",
-                        "value": occupancy,
-                        "unit": "fraction",
+                        "metric": f"{metric}.ledger_dispatches",
+                        "value": dispatches,
+                        "unit": "dispatches",
+                        "backend": line.get("backend"),
+                    }
+                )
+                occupancy = block.get("occupancy")
+                if isinstance(occupancy, (int, float)):
+                    out.append(
+                        {
+                            "metric": f"{metric}.ledger_occupancy",
+                            "value": occupancy,
+                            "unit": "fraction",
+                            "backend": line.get("backend"),
+                        }
+                    )
+        for field in ("boot_cold_ms", "boot_cached_ms"):
+            value = line.get(field)
+            if isinstance(value, (int, float)) and value > 0:
+                out.append(
+                    {
+                        "metric": f"{metric}.{field}",
+                        "value": value,
+                        "unit": "ms",
                         "backend": line.get("backend"),
                     }
                 )

@@ -49,6 +49,8 @@ from ..verify import batch as vbatch
 from ..verify.batch import (
     ADDRESS_BYTES,
     SIG_BYTES,
+    _BATCH_BUCKETS,
+    _bucket,
     pack_seal_lanes,
     pack_validator_table,
 )
@@ -126,9 +128,29 @@ class CoalescedDispatcher:
       calibration the :class:`AdaptiveBatchVerifier` uses: a handful of
       lanes never pays a device dispatch floor), device at or above it;
     * ``"host"`` / ``"device"`` — forced (bench variants, tests).
+
+    ``mesh`` / ``dp`` / ``devices`` (live mesh resize, ISSUE 16): when a
+    multi-device mesh resolves (``mesh`` wins; else ``dp``/``devices``
+    re-enter through :func:`~go_ibft_tpu.parallel.mesh.mesh_context`),
+    the device route dispatches the lane-sharded
+    :func:`~go_ibft_tpu.verify.mesh_batch.mesh_verify_mask` program —
+    the SAME pinned ``mesh_verify_mask_*_dp*`` family the single-tenant
+    sharded verifier compiled — with lanes pinned to ``bucket x dp`` so
+    every shard sees an identical local shape (pad lanes are dead).  A
+    1-device resolution degrades to the single-device kernels exactly.
+    The scheduler swaps whole dispatchers to resize
+    (:meth:`TenantScheduler.reconfigure`), so this state is immutable.
     """
 
-    def __init__(self, route: str = "auto", cutover_lanes: Optional[int] = None):
+    def __init__(
+        self,
+        route: str = "auto",
+        cutover_lanes: Optional[int] = None,
+        *,
+        mesh=None,
+        dp: Optional[int] = None,
+        devices=None,
+    ):
         if route not in ("auto", "host", "device"):
             raise ValueError(f"unknown route {route!r}")
         self.route = route
@@ -140,8 +162,39 @@ class CoalescedDispatcher:
                 or calibration.DEFAULT_CUTOVER_LANES
             )
         self.cutover = cutover_lanes
+        self.mesh = None
+        self.dp = 1
+        self._mask_kernel = None
+        if mesh is None and (dp is not None or devices is not None):
+            from ..parallel.mesh import mesh_context
+
+            mesh = mesh_context(dp, devices=devices)
+        if mesh is not None and mesh.devices.size >= 2:
+            from ..verify.mesh_batch import mesh_verify_mask
+
+            self.mesh = mesh
+            self.dp = int(np.prod(mesh.devices.shape))
+            self._mask_kernel = mesh_verify_mask(mesh)
         # The recover programs compile per lane bucket; serialize warmup.
         self._warm_lock = threading.Lock()
+
+    def describe(self) -> dict:
+        """Shape of this dispatcher (scheduler stats / resize evidence)."""
+        return {
+            "route": self.route,
+            "dp": self.dp,
+            "sharded": self.mesh is not None,
+            "cutover": self.cutover,
+        }
+
+    def _pad_lanes(self, n: int) -> int:
+        """Mesh dispatches pin the lane dim to ``bucket(ceil(n/dp)) x dp``
+        (every shard gets an identical local shape; pad lanes are dead);
+        single-device dispatches keep the pack functions' own bucketing
+        (``pad_lanes=0``)."""
+        if self.mesh is None or n == 0:
+            return 0
+        return _bucket((n + self.dp - 1) // self.dp, _BATCH_BUCKETS) * self.dp
 
     # -- public ----------------------------------------------------------
 
@@ -152,21 +205,32 @@ class CoalescedDispatcher:
 
         with self._warm_lock:
             for bb in lanes:
+                # Warm the kernel the device route will actually launch:
+                # the sharded mask program at its dp-aligned global shape
+                # when a mesh is attached, the single-device recover
+                # ladder otherwise.
+                gg = self._pad_lanes(bb) if self.mesh is not None else bb
+                kernel = (
+                    self._mask_kernel if self.mesh is not None else RECOVER_KERNEL
+                )
+                program = (
+                    "mesh_verify_mask" if self.mesh is not None else "ecdsa_recover"
+                )
                 with cost_ledger.dispatch_span(
-                    "ecdsa_recover",
+                    program,
                     route="warmup",
-                    padded=bb,
-                    kernels=(("ecdsa_recover", RECOVER_KERNEL),),
+                    padded=gg,
+                    kernels=((program, kernel),),
                     site="sched/dispatch.py:warmup",
                 ):
-                    RECOVER_KERNEL(
-                        jnp.zeros((bb, 8), jnp.uint32),
-                        jnp.zeros((bb, 20), jnp.int32),
-                        jnp.zeros((bb, 20), jnp.int32),
-                        jnp.zeros((bb,), jnp.int32),
-                        jnp.zeros((bb, 5), jnp.uint32),
+                    kernel(
+                        jnp.zeros((gg, 8), jnp.uint32),
+                        jnp.zeros((gg, 20), jnp.int32),
+                        jnp.zeros((gg, 20), jnp.int32),
+                        jnp.zeros((gg,), jnp.int32),
+                        jnp.zeros((gg, 5), jnp.uint32),
                         jnp.zeros((table_rows, 5), jnp.uint32),
-                        jnp.zeros((bb,), bool),
+                        jnp.zeros((gg,), bool),
                     ).block_until_ready()
                 with cost_ledger.dispatch_span(
                     "digest_words",
@@ -233,8 +297,6 @@ class CoalescedDispatcher:
     # -- device route ----------------------------------------------------
 
     def _device(self, msgs, lanes, owners) -> Tuple[np.ndarray, np.ndarray]:
-        import jax.numpy as jnp
-
         sender_ok = np.zeros(len(msgs), dtype=bool)
         seal_ok = np.zeros(len(lanes), dtype=bool)
         if msgs:
@@ -250,6 +312,7 @@ class CoalescedDispatcher:
                     (owners[id(m)].lookup(m) if id(m) in owners else None)
                     for m in msgs
                 ],
+                pad_lanes=self._pad_lanes(len(msgs)),
             )
             # Claimed-address table: every live lane's claimed sender is a
             # member by construction, so the kernel's (sig & member) mask
@@ -258,46 +321,48 @@ class CoalescedDispatcher:
             table = pack_validator_table(
                 list(dict.fromkeys(m.sender for m in msgs))
             )
-            with cost_ledger.dispatch_span(
-                "ecdsa_recover",
-                route="device",
-                live_mask=live,
-                kernels=(("ecdsa_recover", RECOVER_KERNEL),),
-                site="sched/dispatch.py:_device",
-            ):
-                mask = RECOVER_KERNEL(
-                    jnp.asarray(zw),
-                    jnp.asarray(r),
-                    jnp.asarray(s),
-                    jnp.asarray(v),
-                    jnp.asarray(claimed),
-                    jnp.asarray(table),
-                    jnp.asarray(live),
-                )
-                sender_ok = np.asarray(mask)[: len(msgs)]
+            sender_ok = self._sig_mask(zw, r, s, v, claimed, table, live)[
+                : len(msgs)
+            ]
         if lanes:
-            hz, r, s, v, signers, live = pack_seal_lanes(list(lanes))
+            hz, r, s, v, signers, live = pack_seal_lanes(
+                list(lanes), pad_lanes=self._pad_lanes(len(lanes))
+            )
             table = pack_validator_table(
                 list(dict.fromkeys(seal.signer for _h, seal in lanes))
             )
-            with cost_ledger.dispatch_span(
-                "ecdsa_recover",
-                route="device",
-                live_mask=live,
-                kernels=(("ecdsa_recover", RECOVER_KERNEL),),
-                site="sched/dispatch.py:_device",
-            ):
-                mask = RECOVER_KERNEL(
-                    jnp.asarray(hz),
-                    jnp.asarray(r),
-                    jnp.asarray(s),
-                    jnp.asarray(v),
-                    jnp.asarray(signers),
-                    jnp.asarray(table),
-                    jnp.asarray(live),
-                )
-                seal_ok = np.asarray(mask)[: len(lanes)]
+            seal_ok = self._sig_mask(hz, r, s, v, signers, table, live)[
+                : len(lanes)
+            ]
         return sender_ok, seal_ok
+
+    def _sig_mask(self, zw, r, s, v, claimed, table, live) -> np.ndarray:
+        """One signature-validity kernel launch: the sharded mask program
+        over an attached mesh, the single-device recover ladder otherwise
+        (identical argument layout — mesh_batch kept the sharded program a
+        thin shell around the single-chip one)."""
+        import jax.numpy as jnp
+
+        sharded = self.mesh is not None
+        kernel = self._mask_kernel if sharded else RECOVER_KERNEL
+        program = "mesh_verify_mask" if sharded else "ecdsa_recover"
+        with cost_ledger.dispatch_span(
+            program,
+            route="mesh" if sharded else "device",
+            live_mask=live,
+            kernels=((program, kernel),),
+            site="sched/dispatch.py:_device",
+        ):
+            mask = kernel(
+                jnp.asarray(zw),
+                jnp.asarray(r),
+                jnp.asarray(s),
+                jnp.asarray(v),
+                jnp.asarray(claimed),
+                jnp.asarray(table),
+                jnp.asarray(live),
+            )
+            return np.asarray(mask)
 
     # -- host route ------------------------------------------------------
 

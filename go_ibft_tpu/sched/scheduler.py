@@ -153,6 +153,14 @@ class _SealVerdictCache:
         self._round = 0
         self._cap = cap
 
+    @property
+    def cap(self) -> int:
+        return self._cap
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._count
+
     def note_round(self, round_: int) -> None:
         with self._lock:
             self._round = round_
@@ -199,6 +207,9 @@ class _Tenant:
         validators: Callable[[int], Mapping[bytes, int]],
         calibrator=None,
         priority: str = "consensus",
+        max_queue_lanes: Optional[int] = None,
+        pack_cache_cap: Optional[int] = None,
+        verdict_cache_cap: Optional[int] = None,
     ):
         self.tid = tid
         self.chain_id = chain_id
@@ -213,10 +224,27 @@ class _Tenant:
         self.queue: Deque[_Request] = deque()
         self.queued_lanes = 0
         self.deficit = 0
+        # Per-tenant budgets (ISSUE 16): an explicit queue-lane bound
+        # overrides the scheduler-wide default, and the cache caps size
+        # THIS tenant's slice of process memory — a 4-validator chain can
+        # ride along a 100-validator one without inheriting its footprint.
+        self.max_queue_lanes = max_queue_lanes
+        # ``draining`` marks a tenant mid-removal: new submissions are
+        # refused (the handle's host oracle serves them — shed, not
+        # dropped) while already-queued work keeps flushing.
+        self.draining = False
         # Namespaced caches (satellite: process-shared caches keyed by
         # tenant — lifecycle hooks touch only THIS tenant's state).
-        self.pack_cache = PackCache()
-        self.verdicts = _SealVerdictCache()
+        self.pack_cache = (
+            PackCache(cap=pack_cache_cap)
+            if pack_cache_cap is not None
+            else PackCache()
+        )
+        self.verdicts = (
+            _SealVerdictCache(cap=verdict_cache_cap)
+            if verdict_cache_cap is not None
+            else _SealVerdictCache()
+        )
         # SLO evidence.  ``slo_lock`` orders the scheduler thread's
         # sample appends (_complete) against stats() snapshots — a live
         # monitoring scrape must never crash on a mutating deque.
@@ -289,6 +317,13 @@ class TenantScheduler:
         self._pending_lanes = 0
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Live-reconfiguration state (ISSUE 16): ``_inflight`` counts
+        # flushes currently executing outside the lock; ``_paused`` stops
+        # the loop from starting new ones while :meth:`reconfigure` drains
+        # and swaps the dispatcher.  Submissions stay open throughout —
+        # queued work just waits out the (one-flush) pause.
+        self._inflight = 0
+        self._paused = False
         # Evidence counters (config #10 reads these via stats()).
         self.dispatches = 0
         self.coalesced_requests = 0
@@ -333,6 +368,52 @@ class TenantScheduler:
         """Pre-compile the shared kernels (node startup; never mid-round)."""
         self._dispatcher.warmup(**kw)
 
+    def reconfigure(
+        self,
+        *,
+        dispatcher: Optional[CoalescedDispatcher] = None,
+        route: Optional[str] = None,
+        dp: Optional[int] = None,
+        devices=None,
+        warm_lanes: Optional[Sequence[int]] = None,
+        table_rows: int = 8,
+    ) -> dict:
+        """Zero-downtime dispatcher swap / device-mesh resize (ISSUE 16).
+
+        The replacement dispatcher is built — and, with ``warm_lanes``,
+        pre-compiled — BEFORE the flush loop pauses, so every tenant keeps
+        draining through the old data plane while the new mesh programs
+        compile; the swap itself waits only for the single in-flight
+        flush.  ``dp`` / ``devices`` re-enter through
+        :func:`~go_ibft_tpu.parallel.mesh.mesh_context` (a 1-device
+        resolution degrades to the single-device kernels); an explicit
+        ``dispatcher`` wins over all shape arguments.  Submissions stay
+        open throughout and queued requests survive the swap untouched —
+        no tenant misses a height.  Returns ``{"old", "new"}`` dispatcher
+        descriptions (the churn-soak evidence)."""
+        if dispatcher is None:
+            kw = {}
+            if dp is not None or devices is not None:
+                kw = {"dp": dp, "devices": devices}
+            dispatcher = CoalescedDispatcher(
+                route if route is not None else self._dispatcher.route, **kw
+            )
+        if warm_lanes:
+            dispatcher.warmup(lanes=warm_lanes, table_rows=table_rows)
+        old = self._dispatcher
+        with self._cv:
+            self._paused = True
+            try:
+                while self._inflight:
+                    self._cv.wait()
+                self._dispatcher = dispatcher
+            finally:
+                self._paused = False
+                self._cv.notify_all()
+        desc = {"old": old.describe(), "new": dispatcher.describe()}
+        trace.instant("sched.reconfigure", **desc["new"])
+        return desc
+
     # -- tenants ---------------------------------------------------------
 
     def register(
@@ -342,6 +423,9 @@ class TenantScheduler:
         *,
         chain_id: Optional[str] = None,
         priority: str = "consensus",
+        max_queue_lanes: Optional[int] = None,
+        pack_cache_cap: Optional[int] = None,
+        verdict_cache_cap: Optional[int] = None,
     ) -> "TenantVerifierHandle":
         """Register one tenant (typically one engine of one chain) and
         return its scheduler-backed verifier handle.  ``chain_id`` labels
@@ -349,7 +433,12 @@ class TenantScheduler:
         ``priority`` is the QoS class: ``"consensus"`` (default) for live
         rounds, ``"read"`` for the proof-serving plane — read lanes only
         fill dispatch capacity consensus left unused, so a proof flood
-        can never starve a finalizing chain."""
+        can never starve a finalizing chain.
+
+        Per-tenant budgets (ISSUE 16): ``max_queue_lanes`` bounds THIS
+        tenant's queue (overriding the scheduler-wide default), and
+        ``pack_cache_cap`` / ``verdict_cache_cap`` size its private
+        caches — all surfaced per tenant in :meth:`stats`."""
         if priority not in PRIORITY_RANK:
             raise ValueError(
                 f"unknown priority {priority!r} "
@@ -370,10 +459,61 @@ class TenantScheduler:
                     else None
                 ),
                 priority=priority,
+                max_queue_lanes=max_queue_lanes,
+                pack_cache_cap=pack_cache_cap,
+                verdict_cache_cap=verdict_cache_cap,
             )
             self._tenants[tenant_id] = tenant
             self._rr.append(tenant_id)
         return TenantVerifierHandle(self, tenant)
+
+    def add_tenant(self, tenant_id, validators_for_height, **kw):
+        """Zero-downtime registration (ISSUE 16 naming): identical to
+        :meth:`register` — registration has always been safe while the
+        flush loop runs (one lock-guarded map insert; the next selection
+        pass sees the tenant), so adding a chain to a live scheduler
+        costs no pause and no other tenant a height."""
+        return self.register(tenant_id, validators_for_height, **kw)
+
+    def remove_tenant(
+        self,
+        tenant_id: str,
+        *,
+        drain: bool = True,
+        timeout_s: float = 30.0,
+    ) -> bool:
+        """Zero-downtime removal.  With ``drain`` (default) the tenant
+        stops accepting NEW submissions immediately — its handle sheds
+        them to the host oracle, so verdicts are never lost — while
+        everything already queued keeps flushing through the shared
+        dispatch; the tenant is dropped once its queue empties.
+        ``drain=False`` (or a drain timeout, or a stopped scheduler)
+        refuses the still-queued requests back to their callers' oracles,
+        exactly like :meth:`unregister`.  Returns True when the queue
+        drained clean.  Survivor tenants never miss a height either way:
+        nothing pauses, their queued lanes keep shipping."""
+        with self._cv:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None:
+                return True
+            tenant.draining = True
+        if drain:
+            deadline = time.monotonic() + timeout_s
+            with self._cv:
+                while tenant.queue and self._running:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    # Flush completions notify; the poll cap bounds the
+                    # wait if one slips past between check and wait.
+                    self._cv.wait(timeout=min(left, 0.05))
+        with self._cv:
+            drained = not tenant.queue
+        self.unregister(tenant_id)
+        trace.instant(
+            "sched.remove_tenant", tenant=tenant_id, drained=drained
+        )
+        return drained
 
     def unregister(self, tenant_id: str) -> None:
         with self._cv:
@@ -408,10 +548,20 @@ class TenantScheduler:
         with self._cv:
             if not self._running:
                 raise SchedQueueFull("scheduler is not running")
-            if tenant.queued_lanes + req.lanes > self.max_queue_lanes:
+            if tenant.draining or self._tenants.get(tenant.tid) is not tenant:
+                # Mid-removal (or an already-removed handle): refuse so
+                # the caller's oracle serves the verdict immediately
+                # instead of queueing work nothing will ever select.
+                raise SchedQueueFull(f"tenant {tenant.tid!r} is draining")
+            cap = (
+                tenant.max_queue_lanes
+                if tenant.max_queue_lanes is not None
+                else self.max_queue_lanes
+            )
+            if tenant.queued_lanes + req.lanes > cap:
                 raise SchedQueueFull(
                     f"tenant {tenant.tid!r} queue at {tenant.queued_lanes} "
-                    f"lanes (cap {self.max_queue_lanes})"
+                    f"lanes (cap {cap})"
                 )
             req.submitted_at = time.monotonic()
             if tenant.calibrator is not None:
@@ -473,8 +623,11 @@ class TenantScheduler:
 
     def _loop(self) -> None:
         while True:
+            batch: List[_Request] = []
             with self._cv:
-                while self._running and self._pending_reqs == 0:
+                while self._running and (
+                    self._pending_reqs == 0 or self._paused
+                ):
                     self._cv.wait()
                 if self._pending_reqs == 0 and not self._running:
                     return
@@ -482,7 +635,7 @@ class TenantScheduler:
                 # oldest queued request ages past the (arrival-calibrated)
                 # window.  Idle tenants contribute no requests and thus no
                 # delay.
-                while self._running:
+                while self._running and not self._paused:
                     if self._pending_lanes >= self.max_dispatch_lanes:
                         break
                     oldest = self._oldest_ts_locked()
@@ -494,9 +647,19 @@ class TenantScheduler:
                     self._cv.wait(timeout=wait)
                     if self._pending_reqs == 0:
                         break
-                batch = self._select_locked()
+                if not (self._paused and self._running):
+                    # A running pause (reconfigure draining the dispatcher)
+                    # selects nothing; stop() still drains everything.
+                    batch = self._select_locked()
+                    if batch:
+                        self._inflight += 1
             if batch:
-                self._flush(batch)
+                try:
+                    self._flush(batch)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
 
     def _select_locked(self) -> List[_Request]:
         """Pick one dispatch's worth of requests.
@@ -673,6 +836,20 @@ class TenantScheduler:
                 "arrival": (
                     t.calibrator.stats() if t.calibrator is not None else None
                 ),
+                "draining": t.draining,
+                # Per-tenant memory/queue budgets (ISSUE 16): live
+                # occupancy vs cap for each namespaced resource.
+                "budgets": {
+                    "queue_lanes_cap": (
+                        t.max_queue_lanes
+                        if t.max_queue_lanes is not None
+                        else self.max_queue_lanes
+                    ),
+                    "pack_entries": len(t.pack_cache),
+                    "pack_cap": t.pack_cache.cap,
+                    "verdict_entries": len(t.verdicts),
+                    "verdict_cap": t.verdicts.cap,
+                },
             }
 
         with self._cv:
@@ -692,6 +869,13 @@ class TenantScheduler:
                 round(requests / dispatches, 3) if dispatches else None
             ),
             "flush_faults": faults,
+            # Tests wrap the dispatcher in doubles without describe();
+            # degrade to the class name rather than breaking stats().
+            "dispatcher": (
+                self._dispatcher.describe()
+                if hasattr(self._dispatcher, "describe")
+                else {"route": type(self._dispatcher).__name__}
+            ),
         }
 
 
@@ -734,6 +918,22 @@ class TenantVerifierHandle:
     def quarantine(self, msgs: Sequence[IbftMessage]) -> None:
         for m in msgs:
             self._tenant.pack_cache.evict(m)
+
+    def seed_seal_verdicts(self, entries) -> int:
+        """Warm-start hook (ISSUE 16): pre-load seal verdicts replayed
+        from the WAL into THIS tenant's verdict cache.  ``entries`` is an
+        iterable of ``((signer, proposal_hash, signature, height), bool)``
+        pairs — the exact cache key :meth:`verify_committed_seals` uses —
+        so a restarted node's first seal drain after recovery is cache
+        hits, not device (or oracle) lanes.  Sound because every seeded
+        verdict comes from a finalized block the WAL already trusts
+        (see go_ibft_tpu/boot/warmstart.py)."""
+        n = 0
+        verdicts = self._tenant.verdicts
+        for key, verdict in entries:
+            verdicts.store(tuple(key), bool(verdict))
+            n += 1
+        return n
 
     def warmup(self, **kw) -> None:
         self._sched.warmup(**kw)

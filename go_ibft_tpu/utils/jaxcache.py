@@ -5,34 +5,133 @@ milliseconds to load from the persistent cache.  A consensus engine cannot
 stall mid-round for a compile (the round timer would expire, SURVEY.md §7
 (d)), so anything constructing device verifiers should enable the cache and
 pre-warm the hot shapes.
+
+The cache directory resolves ``path`` argument > ``GO_IBFT_CACHE_DIR`` >
+``JAX_COMPILATION_CACHE_DIR`` (JAX reads the latter natively) > the default
+``~/.cache/go_ibft_tpu/xla``.  Growth is bounded by the same posture as the
+backend probe cache (obs/evidence.py): entries older than
+``GO_IBFT_CACHE_TTL_S`` are dropped, and when the directory exceeds
+``GO_IBFT_CACHE_MAX_BYTES`` the oldest entries are evicted first.  JAX's
+own cache key covers jax version / backend / XLA flags, so entries written
+by an older jax can never be *loaded* as a wrong program — the TTL merely
+stops them from squatting on disk after a version bump.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
 import jax
 
 _DEFAULT_DIR = os.path.expanduser("~/.cache/go_ibft_tpu/xla")
 
+# Bounded-growth defaults: generous enough that a full warm_kernels sweep
+# (every pinned family, multiple shape buckets, ~tens of MB each) never
+# evicts itself, small enough that years of jax bumps cannot fill a disk.
+DEFAULT_MAX_BYTES = 4 << 30  # 4 GiB
+DEFAULT_TTL_S = 30 * 24 * 3600.0  # 30 days
+
 _enabled = False
 
 
-def enable_persistent_cache(path: Optional[str] = None) -> None:
+def resolve_cache_dir(path: Optional[str] = None) -> str:
+    """The cache directory ``enable_persistent_cache`` would select."""
+    current = jax.config.jax_compilation_cache_dir
+    if current is not None:
+        return current
+    return (
+        path
+        or os.environ.get("GO_IBFT_CACHE_DIR")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or _DEFAULT_DIR
+    )
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> str:
     """Idempotently enable the JAX persistent compilation cache.
 
-    Respects an existing user-configured cache dir; otherwise uses
-    ``~/.cache/go_ibft_tpu/xla`` (override with ``path`` or the
-    ``JAX_COMPILATION_CACHE_DIR`` env var, which JAX reads natively).
+    Respects an existing user-configured cache dir; otherwise resolves via
+    :func:`resolve_cache_dir`.  Prunes the directory once per process (TTL
+    + size bound) before handing it to jax.  Returns the effective dir.
     """
     global _enabled
+    target = resolve_cache_dir(path)
     if _enabled:
-        return
-    current = jax.config.jax_compilation_cache_dir
-    if current is None:
-        target = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT_DIR
+        return target
+    if jax.config.jax_compilation_cache_dir is None:
         os.makedirs(target, exist_ok=True)
+        prune_cache(target)
         jax.config.update("jax_compilation_cache_dir", target)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    # Floor below which compiles are not persisted (they cost less than
+    # the disk round-trip).  ``GO_IBFT_CACHE_MIN_COMPILE_S=0`` persists
+    # everything — the CI boot check uses it so even the sub-second
+    # digest program proves a second-boot cache load.
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("GO_IBFT_CACHE_MIN_COMPILE_S", 1)),
+    )
     _enabled = True
+    return target
+
+
+def prune_cache(
+    path: Optional[str] = None,
+    *,
+    max_bytes: Optional[int] = None,
+    max_age_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> Tuple[int, int]:
+    """Bound the persistent cache: drop stale entries, evict oldest-first.
+
+    Runs once per process from :func:`enable_persistent_cache` (explicit
+    calls always run).  Never raises — a concurrently-pruning sibling
+    process or a read-only cache degrades to a no-op, mirroring the probe
+    cache's never-fault posture.  Returns ``(files_removed, bytes_removed)``.
+    """
+    target = path or resolve_cache_dir()
+    if max_bytes is None:
+        max_bytes = int(
+            os.environ.get("GO_IBFT_CACHE_MAX_BYTES", DEFAULT_MAX_BYTES)
+        )
+    if max_age_s is None:
+        max_age_s = float(os.environ.get("GO_IBFT_CACHE_TTL_S", DEFAULT_TTL_S))
+    ts = time.time() if now is None else now
+    entries = []  # (mtime, size, path)
+    try:
+        for root, _dirs, files in os.walk(target):
+            for name in files:
+                p = os.path.join(root, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+    except OSError:
+        return (0, 0)
+    removed = freed = 0
+
+    def _rm(size: int, p: str) -> None:
+        nonlocal removed, freed
+        try:
+            os.remove(p)
+        except OSError:
+            return
+        removed += 1
+        freed += size
+
+    live = []
+    for mtime, size, p in entries:
+        if max_age_s > 0 and ts - mtime > max_age_s:
+            _rm(size, p)
+        else:
+            live.append((mtime, size, p))
+    if max_bytes > 0:
+        total = sum(size for _m, size, _p in live)
+        for mtime, size, p in sorted(live):  # oldest first
+            if total <= max_bytes:
+                break
+            _rm(size, p)
+            total -= size
+    return (removed, freed)

@@ -428,6 +428,10 @@ class PackCache:
         with self._lock:
             return self._count
 
+    @property
+    def cap(self) -> int:
+        return self._cap
+
     def lookup(self, msg) -> Optional[SenderPack]:
         mid = id(msg)
         with self._lock:

@@ -1,0 +1,96 @@
+"""Second-boot cache proof over two AOT manifests (CI fast tier).
+
+``make boot-check`` runs ``scripts/warm_kernels.py --aot-only`` twice
+against one fresh temp cache dir and hands both manifests here:
+
+* run 1 (cold dir) pays the real compile and must RECORD it — a manifest
+  with zero events means the "cold" leg found a pre-warmed cache and the
+  comparison would prove nothing;
+* run 2 (same dir, ``--no-skip``) must hit the persistent cache, so its
+  measured per-family wall must collapse.  The gate is a RATIO (default:
+  second run < 50% of the first), not an absolute threshold — it scales
+  with machine speed instead of flaking on slow CI runners (the measured
+  regime on the digest family is ~10x: 0.43 s compile vs 0.04 s load).
+
+Both manifests must carry the same, non-stale fingerprint (jax version /
+backend / device count) — a mismatch means the two runs didn't exercise
+the same cache key and the ratio is meaningless.
+
+Exit code: 0 = cache proven, 2 = check failed, 1 = unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def check(m1: dict, m2: dict, *, ratio: float) -> list:
+    """Return a list of human-readable failures (empty = pass)."""
+    failures = []
+    if m1.get("fingerprint") != m2.get("fingerprint"):
+        failures.append(
+            f"fingerprint mismatch: {m1.get('fingerprint')} vs "
+            f"{m2.get('fingerprint')} — the runs keyed different caches"
+        )
+    p1, p2 = m1.get("programs", {}), m2.get("programs", {})
+    if not p1:
+        failures.append("first manifest recorded no programs")
+    for family, acc1 in sorted(p1.items()):
+        acc2 = p2.get(family)
+        if acc2 is None:
+            failures.append(f"{family}: missing from second manifest")
+            continue
+        cold_ms = float(acc1.get("compile_ms", 0.0))
+        warm_ms = float(acc2.get("compile_ms", 0.0))
+        if cold_ms <= 0.0:
+            failures.append(
+                f"{family}: first run measured no compile wall — the "
+                "'cold' leg never compiled (pre-warmed cache dir?)"
+            )
+            continue
+        if warm_ms >= cold_ms * ratio:
+            failures.append(
+                f"{family}: second boot paid {warm_ms:.1f} ms vs "
+                f"{cold_ms:.1f} ms cold (>= {ratio:.0%}) — the persistent "
+                "cache did not absorb the compile"
+            )
+        else:
+            print(
+                f"boot-check {family}: cold {cold_ms:.1f} ms -> warm "
+                f"{warm_ms:.1f} ms ({warm_ms / cold_ms:.1%})"
+            )
+    return failures
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="scripts/boot_check.py")
+    p.add_argument("cold_manifest", help="manifest from the cold run")
+    p.add_argument("warm_manifest", help="manifest from the second run")
+    p.add_argument(
+        "--ratio",
+        type=float,
+        default=0.5,
+        help="second run must cost less than this fraction of the first "
+        "per family (default 0.5; measured regime is ~0.1)",
+    )
+    args = p.parse_args(argv)
+    try:
+        with open(args.cold_manifest) as fh:
+            m1 = json.load(fh)
+        with open(args.warm_manifest) as fh:
+            m2 = json.load(fh)
+    except (OSError, ValueError) as err:
+        print(f"boot-check: unreadable manifest: {err}", file=sys.stderr)
+        return 1
+    failures = check(m1, m2, ratio=args.ratio)
+    for failure in failures:
+        print(f"boot-check FAIL: {failure}", file=sys.stderr)
+    if not failures:
+        print("boot-check OK: second boot loaded every family from cache")
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

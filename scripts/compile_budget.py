@@ -53,149 +53,41 @@ CHECK_GROWTH_LIMIT = 0.02
 
 
 def _programs() -> dict:
-    """Lower each budget-tracked program at its engine-hot shape."""
-    import jax
-    import jax.numpy as jnp
+    """Lower each budget-tracked program at its engine-hot shape.
 
-    from go_ibft_tpu.ops import quorum, secp256k1 as sec
-    from go_ibft_tpu.parallel import make_mesh, mesh_quorum_certify
-    from go_ibft_tpu.verify.mesh_batch import mesh_verify_mask
+    The shapes live in ``go_ibft_tpu/boot/registry.py`` (ISSUE 16: the
+    AOT program store restores the SAME registry at boot, so the budget
+    ratchet and the warm-start plane can never pin different programs).
+    The why of each pin, kept from the original in-line registry:
 
-    L = sec.FIELD.nlimbs
-    B = 8  # the engine-route lane bucket (the acceptance-tracked compile)
-    blocks = jnp.zeros((B, 2, 17, 2), jnp.uint32)
-    counts = jnp.ones((B,), jnp.int32)
-    limbs = jnp.zeros((B, L), jnp.int32)
-    v = jnp.zeros((B,), jnp.int32)
-    addr = jnp.zeros((B, 5), jnp.uint32)
-    table = jnp.zeros((8, 5), jnp.uint32)
-    live = jnp.zeros((B,), bool)
-    power = jnp.zeros((8,), jnp.int32)
-    hash_zw = jnp.zeros((B, 8), jnp.uint32)
-    thr = jnp.int32(1)
+    * The multi-chip programs (shard_map meshes at dp = 2/4/8) pin two
+      families per dp — ``mesh_quorum_certify`` (the fused dryrun
+      program, 8 GLOBAL lanes, keeping the 27,370-line mark comparable)
+      and ``mesh_verify_mask`` (the MeshBatchVerifier drain program at 8
+      LOCAL lanes per shard, so the per-dp delta isolates the shard_map
+      wrapper).  Both must stay thin shells around the single-chip
+      program — SPMD propagation or a collective regression that
+      re-traces the EC ladder per shard shows up as per-dp growth first.
+    * ``bls_aggregate_verify_8v`` (ISSUE 7): the largest trace in the
+      repo (~414k stablehlo lines at 8 lanes on jax 0.4.37), the most
+      cold-compile-sensitive — a tower-arithmetic refactor that
+      re-instantiates the Fp12 ops per call site adds MINUTES of compile.
+    * The ISSUE 12 aggregation families — the scanned g2 merge tree at
+      the 128-validator bucket (ONE lax.scan over halving levels: bucket
+      growth must NOT grow the trace proportionally) and the batched
+      multi-pairing Miller stage at 8 lanes.  The final-exp stages are
+      deliberately NOT pinned: multi_pairing_check reuses the SAME
+      staged jit objects aggregate_verify_commit compiled (identity
+      pinned by tests/test_aggregate.py).
+    * The ISSUE 14 additions — the keccak digest pack and the G1 merge
+      tree — exist so every family the cost ledger attributes has a pin.
+    """
+    out = {}
+    from go_ibft_tpu.boot.registry import program_registry
 
-    def lines(fn, *args) -> int:
-        return len(jax.jit(fn).lower(*args).as_text().splitlines())
-
-    # The multi-chip programs: shard_map meshes at dp = 2/4/8.  Two
-    # program families are pinned per dp:
-    #
-    # * ``mesh_quorum_certify`` — the fused quorum-certify dryrun program
-    #   (8 GLOBAL lanes, matching the original dp=2 pin so the 27,370-line
-    #   mark stays comparable);
-    # * ``mesh_verify_mask`` — the MeshBatchVerifier production drain
-    #   program, lowered at 8 LOCAL lanes per shard (global = 8 x dp) so
-    #   every dp pins the same per-shard shape and the per-dp delta
-    #   isolates the shard_map wrapper itself.
-    #
-    # Both must stay thin shells around the single-chip program — SPMD
-    # propagation or a collective regression that re-traces the EC ladder
-    # per shard shows up as per-dp line growth here first.
-    # The aggregate-BLS pairing program (ISSUE 7): by far the largest
-    # trace in the repo (~414k stablehlo lines at 8 lanes on jax 0.4.37)
-    # and therefore the most cold-compile-sensitive — a tower-arithmetic
-    # refactor that re-instantiates the Fp12 ops per call site would add
-    # MINUTES of compile before any pairing runs.  Lowered at the same
-    # 8-lane shape as the other engine-route pins.
-    from go_ibft_tpu.bench.bls_workload import build_bls_round_workload
-    from go_ibft_tpu.ops.bls12_381 import (
-        _multi_miller_stage,
-        aggregate_verify_commit,
-        g2_merge_tree,
-    )
-
-    bls_w = build_bls_round_workload(8, time_host=False)
-    bls_args = tuple(jnp.asarray(a) for a in bls_w.args)
-
-    # ISSUE 12: the device-resident aggregation pipeline's NEW program
-    # families — the scanned g2 merge tree at the 128-validator bucket
-    # (the mega-committee aggregation kernel; its tree is ONE lax.scan
-    # over halving levels, so growing the bucket must NOT grow the trace
-    # proportionally) and the batched multi-pairing Miller stage at the
-    # 8-lane bucket.  The final-exponentiation stages are deliberately
-    # NOT pinned separately: multi_pairing_check reuses the SAME staged
-    # jit objects aggregate_verify_commit compiled (identity pinned by
-    # tests/test_aggregate.py::test_multipair_reuses_staged_finalexp_
-    # programs), so batched verification adds exactly these two programs
-    # to the budget.  The dp-sharded mesh multipair wraps this same
-    # pipeline in a collective-free shard_map (a thin shell, like
-    # mesh_verify_mask) and is not lowered here — doing so would double
-    # this script's runtime for a per-dp delta the mesh pins already
-    # demonstrate.
-    fe30 = 30  # BLS Fp limb count
-    merge_g2 = jnp.zeros((128, fe30), jnp.int32)
-    merge_live = jnp.zeros((128,), bool)
-    mm = jnp.zeros((2, 8, fe30), jnp.int32)
-
-    # ISSUE 14: the cost ledger attributes every dispatch into THIS
-    # registry's family names (shape suffix stripped) — so every program
-    # family a seam records must be pinned here, or cost_report's
-    # attribution check reads a correct run as unattributed.  That adds
-    # the two small families that were previously unpinned: the keccak
-    # digest pack program and the G1 merge tree (the G2 twin was already
-    # pinned).  Both are cheap to lower; pinning them also ratchets
-    # their (small) trace sizes like everything else.
-    from go_ibft_tpu.ops.bls12_381 import g1_merge_tree
-
-    merge_g1 = jnp.zeros((128, fe30), jnp.int32)
-
-    out = {
-        "bls_aggregate_verify_8v": lines(aggregate_verify_commit, *bls_args),
-        "bls_g2_merge_tree_128v": len(
-            g2_merge_tree.lower(
-                merge_g2, merge_g2, merge_g2, merge_g2, merge_live
-            )
-            .as_text()
-            .splitlines()
-        ),
-        "bls_g1_merge_tree_128v": len(
-            g1_merge_tree.lower(merge_g1, merge_g1, merge_live)
-            .as_text()
-            .splitlines()
-        ),
-        "digest_words_8l": lines(quorum.digest_words, blocks, counts),
-        "bls_multipair_miller_8l": len(
-            _multi_miller_stage.lower(mm, mm, mm, mm, mm, mm)
-            .as_text()
-            .splitlines()
-        ),
-        "quorum_certify_8l": lines(
-            quorum.quorum_certify,
-            blocks, counts, limbs, limbs, v, addr, table, live, power, power,
-            thr, thr,
-        ),
-        "round_certify_8l": lines(
-            quorum.round_certify,
-            blocks, counts, limbs, limbs, v, addr, live,
-            hash_zw, limbs, limbs, v, addr, live,
-            table, power, power, thr, thr,
-        ),
-        "ecdsa_recover_8l": lines(sec.ecdsa_recover, limbs, limbs, limbs, v),
-        "ecmul2_base_8l": lines(sec.ecmul2_base, limbs, limbs, limbs, limbs),
-    }
-    cpu = jax.devices("cpu")
-    for dp in (2, 4, 8):
-        mesh = make_mesh(dp, devices=cpu[:dp])
-        out[f"mesh_quorum_certify_8l_dp{dp}"] = lines(
-            mesh_quorum_certify(mesh),
-            blocks, counts, limbs, limbs, v, addr, table, live, power, power,
-            thr, thr,
-        )
-        g = B * dp  # 8 local lanes per shard
-        out[f"mesh_verify_mask_8l_dp{dp}"] = len(
-            mesh_verify_mask(mesh)
-            .lower(
-                jnp.zeros((g, 8), jnp.uint32),
-                jnp.zeros((g, L), jnp.int32),
-                jnp.zeros((g, L), jnp.int32),
-                jnp.zeros((g,), jnp.int32),
-                jnp.zeros((g, 5), jnp.uint32),
-                jnp.zeros((8, 5), jnp.uint32),
-                jnp.zeros((g,), bool),
-            )
-            .as_text()
-            .splitlines()
-        )
+    for name, build in program_registry().items():
+        fn, args = build()
+        out[name] = len(fn.lower(*args).as_text().splitlines())
     return out
 
 

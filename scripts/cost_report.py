@@ -34,6 +34,16 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Align with the boot plane's topology (tests/conftest.py, boot/__main__,
+# scripts/warm_kernels.py): the device-count flag is part of both the
+# persistent-cache key and the AOT sidecar fingerprint, so the `cached?`
+# column must read the store under the same posture it was minted with.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 BUDGET_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs",
@@ -65,6 +75,26 @@ def pinned_families(budget_path: str = BUDGET_PATH) -> set:
             family = stripped
         families.add(family)
     return families
+
+
+def aot_cached_families() -> set:
+    """Families the AOT store holds under THIS process's fingerprint.
+
+    Sourced from the boot store's sidecars (``<cache_dir>/aot/``): a
+    family in this set was compiled at BOOT (warm_kernels / boot layer)
+    and the next boot loads it from cache; anything else in the compile
+    tables was paid at first dispatch, mid-round — the exact cost the
+    warm-start plane exists to remove."""
+    try:
+        from go_ibft_tpu.boot.aot import AOTStore, family_of
+
+        return {family_of(p) for p in AOTStore().cached_programs()}
+    except Exception:  # noqa: BLE001 - report must render without jax
+        return set()
+
+
+def _cached_tag(name: str, aot_families: set) -> str:
+    return "boot" if name in aot_families else "first-dispatch"
 
 
 def _table(headers, rows) -> str:
@@ -147,13 +177,19 @@ def render_snapshot(snap: dict, *, top: int = 20, families=None) -> str:
 
     compiles = snap.get("compiles", {})
     if compiles:
+        aot_families = aot_cached_families()
         lines.append("")
         lines.append("== compile cost (per program) ==")
         lines.append(
             _table(
-                ("program", "compiles", "compile_ms"),
+                ("program", "compiles", "compile_ms", "cached?"),
                 [
-                    (name, acc["count"], f"{acc['ms']:.1f}")
+                    (
+                        name,
+                        acc["count"],
+                        f"{acc['ms']:.1f}",
+                        _cached_tag(name, aot_families),
+                    )
                     for name, acc in sorted(
                         compiles.items(), key=lambda kv: -kv[1]["ms"]
                     )
@@ -183,18 +219,20 @@ def render_compile_ledger(path: str, *, top: int = 30) -> str:
     if not events:
         return f"(compile ledger {path!r} holds no events)"
     events.sort(key=lambda e: -e["ms"])
+    aot_families = aot_cached_families()
     lines = [
         f"== compile events in {path} — append-only across runs "
         f"({len(events)} total, top {min(top, len(events))} by duration) =="
     ]
     lines.append(
         _table(
-            ("program", "ms", "shared", "site"),
+            ("program", "ms", "shared", "cached?", "site"),
             [
                 (
                     e["program"],
                     f"{e['ms']:.1f}",
                     e.get("shared_span", 1),
+                    _cached_tag(e["program"], aot_families),
                     e.get("site", ""),
                 )
                 for e in events[:top]

@@ -127,6 +127,29 @@ def test_shared_compile_span_wall_splits_not_multiplies(tmp_path):
     assert 10.0 <= total_ms < 30.0  # ~= one span wall, NOT ~2x
 
 
+def test_compile_ledger_record_schema_is_pinned(tmp_path):
+    """The compile_ledger.jsonl record schema is a cross-process contract:
+    boot/aot.py writes it, bench config #14's second-boot proof and
+    scripts/cost_report.py's event table read it.  Exactly ``{program,
+    ms, site, ts}`` per record, plus ``shared_span`` only when several
+    programs split one timed span."""
+    log = tmp_path / "cl.jsonl"
+    ledger.enable(compile_log=str(log))
+    ledger.record_compile("quorum_certify", 120.5, site="tests/schema")
+    ledger.record_compile("digest_words", 10.0, site="s2", shared_span=2)
+    ledger.disable()
+    records = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert len(records) == 2
+    assert set(records[0]) == {"program", "ms", "site", "ts"}
+    assert records[0]["program"] == "quorum_certify"
+    assert records[0]["ms"] == 120.5
+    assert records[0]["site"] == "tests/schema"
+    assert isinstance(records[0]["ts"], float)
+    # shared_span is additive-only: present iff the wall was split.
+    assert set(records[1]) == {"program", "ms", "site", "ts", "shared_span"}
+    assert records[1]["shared_span"] == 2
+
+
 def test_program_keyspace_is_bounded():
     ledger.enable(max_programs=4)
     for i in range(10):

@@ -1,7 +1,7 @@
 # Developer entry points (reference: go-ibft Makefile — lint / builds-dummy /
 # protoc targets).  Translated to this build's toolchain.
 .PHONY: test test-fast test-slow test-device lint native bench dryrun clean \
-	warm cluster-bench obs-report chain-soak mesh-bench compile-budget \
+	warm cluster-bench cluster-soak obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
 	serve-bench timeline-smoke slo-gates multipair-bench cost-report \
 	boot-bench boot-check
@@ -172,10 +172,24 @@ chain-soak:
 	python -m pytest tests/test_chain_soak.py tests/test_chain.py \
 		tests/test_chain_sync.py -q
 
-# Engine-level throughput: N-node cluster finalizing H heights
+# Lock-step cluster bench (config #15): 100-validator lock-step cluster
+# vs threaded loopback at matched size (chain-identity oracle gated
+# before timing, >=3x acceptance) plus the 1000-validator one-dispatch
+# structural tick.  GO_IBFT_CLUSTER_NODES / GO_IBFT_CLUSTER_HEIGHTS /
+# GO_IBFT_CLUSTER_STRUCT_NODES scale it; scripts/cluster_bench.py is
+# the exploratory one-transport sweep driver.
 cluster-bench:
-	python scripts/cluster_bench.py --nodes 4 --heights 5
-	python scripts/cluster_bench.py --nodes 4 --heights 5 --transport ici
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --cluster-only
+
+# Slow-tier cluster soak: the 1000-validator lock-step smoke plus the
+# seeded 100-validator chaos-mask runs (tests/test_cluster_sim.py)
+cluster-soak:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	python -m pytest tests/test_cluster_sim.py -q -m slow
 
 dryrun:
 	python __graft_entry__.py

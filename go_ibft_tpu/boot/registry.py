@@ -200,6 +200,36 @@ def _build_mesh_verify_mask(dp: int):
     )
 
 
+def _build_ici_tick(n_nodes: int):
+    """The ICI lock-step tick collective (rows variant): all_gather of
+    the ``(N, M, B)`` staging tensor + on-shard payload digests + the
+    gathered sender rows the verify kernels consume — ONE program per
+    consensus tick (net/ici.py).  Pinned at the real-crypto cluster
+    shape: ``n_nodes`` nodes on ``n_nodes`` host devices, 8 lanes of
+    512-byte slots."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..net.ici import build_tick_program
+    from ..ops import secp256k1 as sec
+
+    mesh = Mesh(np.asarray(_cpu_devices(n_nodes)), ("node",))
+    m_slots, b = ENGINE_LANES, 512
+    lanes = n_nodes * m_slots
+    L = sec.FIELD.nlimbs
+    return build_tick_program(mesh, rows=True), (
+        jnp.zeros((n_nodes, m_slots, b), jnp.uint8),
+        jnp.zeros((lanes, 8, 17, 2), jnp.uint32),
+        jnp.ones((lanes,), jnp.int32),
+        jnp.zeros((lanes, L), jnp.int32),
+        jnp.zeros((lanes, L), jnp.int32),
+        jnp.zeros((lanes,), jnp.int32),
+        jnp.zeros((lanes, 5), jnp.uint32),
+        jnp.zeros((lanes,), bool),
+    )
+
+
 def program_registry(
     programs: Optional[Sequence[str]] = None,
 ) -> "OrderedDict[str, Callable[[], Tuple[object, tuple]]]":
@@ -221,6 +251,7 @@ def program_registry(
             ("round_certify_8l", _build_round_certify),
             ("ecdsa_recover_8l", _build_ecdsa_recover),
             ("ecmul2_base_8l", _build_ecmul2_base),
+            ("ici_tick_8n", lambda: _build_ici_tick(8)),
         )
     )
     for dp in MESH_DPS:

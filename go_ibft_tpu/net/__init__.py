@@ -20,6 +20,12 @@ backends behind the same seam:
 
 from .aggtree import AggregationTreeGossip
 from .grpc_transport import GrpcTransport
-from .ici import IciLockstepTransport
+from .ici import IciLockstepTransport, TickVerdictVerifier, build_tick_program
 
-__all__ = ["AggregationTreeGossip", "GrpcTransport", "IciLockstepTransport"]
+__all__ = [
+    "AggregationTreeGossip",
+    "GrpcTransport",
+    "IciLockstepTransport",
+    "TickVerdictVerifier",
+    "build_tick_program",
+]

@@ -1,0 +1,30 @@
+"""Cluster-scale lock-step simulation (the ICI transport as engine).
+
+``ClusterSim`` mounts N IBFT engines on one
+:class:`~go_ibft_tpu.net.ici.IciLockstepTransport` hub and steps them in
+lock-step ticks; ``LoopbackClusterSim`` is the matched threaded-loopback
+baseline (the tests/harness gossip shape) used as the chain oracle and
+the bench comparison point.  ``ChaosMask`` fuses the chaos plane in as
+seeded tensor masks on the collective schedule.  See docs/CLUSTER.md.
+"""
+
+from .backend import SimBackend, sim_address, sim_block, sim_hash
+from .chaos import ChaosMask
+from .cluster import (
+    ClusterResult,
+    ClusterSim,
+    LoopbackClusterSim,
+    run_matched_pair,
+)
+
+__all__ = [
+    "ChaosMask",
+    "ClusterResult",
+    "ClusterSim",
+    "LoopbackClusterSim",
+    "SimBackend",
+    "run_matched_pair",
+    "sim_address",
+    "sim_block",
+    "sim_hash",
+]

@@ -1,0 +1,133 @@
+"""Chaos as tensor masks on the collective schedule.
+
+Where :mod:`go_ibft_tpu.chaos.injector` wraps individual seams with
+seeded fault callables, the lock-step cluster fuses faults into the tick
+itself: :class:`ChaosMask.edges` is a PURE function of ``(seed, tick)``
+returning per-edge ``(allow, delay)`` matrices that
+:meth:`~go_ibft_tpu.net.ici.IciLockstepTransport.step` applies to the
+gathered tensor before drain.  Byte-identical per seed by construction
+(counter-based Philox keyed on ``(seed, tick)`` — no stateful RNG to
+drift), so a run replays from nothing but its CHAOS-REPLAY line.
+
+Fault surface:
+
+* **drops** — edges INTO the ``lossy`` receiver set fail with
+  ``drop_rate``.  Restricting loss to a named minority keeps the
+  connected majority's liveness provable: a dropped PREPREPARE has no
+  retransmit, so uniform loss would eventually wedge arbitrary nodes.
+* **partition** — one ``(start_tick, end_tick, groups)`` epoch; edges
+  crossing group boundaries drop entirely while it lasts.
+* **delay** — edges into lossy receivers defer up to ``delay_max`` whole
+  ticks (the hub re-delivers when due).
+
+Self-edges are never cut: a node always hears its own multicast, as in
+every other transport here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ChaosMask:
+    def __init__(
+        self,
+        n_nodes: int,
+        seed: int = 0,
+        *,
+        drop_rate: float = 0.0,
+        lossy: Sequence[int] = (),
+        delay_max: int = 0,
+        partition: Optional[Tuple[int, int, Sequence[Sequence[int]]]] = None,
+    ) -> None:
+        self.n_nodes = n_nodes
+        self.seed = int(seed)
+        self.drop_rate = float(drop_rate)
+        self.lossy = np.asarray(sorted(set(lossy)), dtype=np.int64)
+        self.delay_max = int(delay_max)
+        self.partition = partition
+        if partition is not None:
+            start, end, groups = partition
+            gid = np.zeros(n_nodes, dtype=np.int64)
+            for g, members in enumerate(groups):
+                for m in members:
+                    gid[m] = g
+            self._same_group = gid[:, None] == gid[None, :]
+            self._epoch = (int(start), int(end))
+        else:
+            self._same_group = None
+            self._epoch = None
+
+    def _rng(self, tick: int) -> np.random.Generator:
+        key = np.array([self.seed, tick], dtype=np.uint64)
+        return np.random.Generator(np.random.Philox(key=key))
+
+    def edges(self, tick: int) -> Tuple[np.ndarray, np.ndarray]:
+        """``(allow, delay)`` for one tick: ``allow[s, r]`` keeps the
+        ``s -> r`` edge, ``delay[s, r]`` defers it that many ticks."""
+        n = self.n_nodes
+        allow = np.ones((n, n), dtype=bool)
+        delay = np.zeros((n, n), dtype=np.int64)
+        if self.lossy.size and (self.drop_rate > 0 or self.delay_max > 0):
+            rng = self._rng(tick)
+            if self.drop_rate > 0:
+                keep = rng.random((n, self.lossy.size)) >= self.drop_rate
+                allow[:, self.lossy] = keep
+            if self.delay_max > 0:
+                delay[:, self.lossy] = rng.integers(
+                    0, self.delay_max + 1, size=(n, self.lossy.size)
+                )
+        if self._epoch is not None:
+            start, end = self._epoch
+            if start <= tick < end:
+                allow &= self._same_group
+        np.fill_diagonal(allow, True)
+        np.fill_diagonal(delay, 0)
+        return allow, delay
+
+    # -- replay ---------------------------------------------------------
+
+    def config(self) -> dict:
+        return {
+            "n_nodes": self.n_nodes,
+            "drop_rate": self.drop_rate,
+            "lossy": [int(i) for i in self.lossy],
+            "delay_max": self.delay_max,
+            "partition": (
+                None
+                if self.partition is None
+                else [
+                    self._epoch[0],
+                    self._epoch[1],
+                    [sorted(int(m) for m in g) for g in self.partition[2]],
+                ]
+            ),
+        }
+
+    def schedule_digest(self, ticks: int) -> str:
+        """Digest of the full mask schedule over ``[0, ticks)`` — two runs
+        with the same seed MUST produce the same digest (the replay
+        check's byte-identity witness)."""
+        h = hashlib.sha256()
+        for t in range(ticks):
+            allow, delay = self.edges(t)
+            h.update(np.packbits(allow).tobytes())
+            h.update(delay.astype(np.int16).tobytes())
+        return h.hexdigest()[:16]
+
+    def replay_line(self, ticks: int) -> str:
+        """CHAOS-REPLAY line in the injector's format
+        (:mod:`go_ibft_tpu.chaos.injector`): everything needed to re-run
+        this schedule byte-identically."""
+        cfg = json.dumps(
+            {"seed": self.seed, **self.config()}, sort_keys=True,
+            separators=(",", ":"),
+        )
+        return (
+            f"CHAOS-REPLAY seed={self.seed} "
+            f"schedule={self.schedule_digest(ticks)} config={cfg}"
+        )

@@ -1,0 +1,304 @@
+"""Lock-step cluster driver: N engines, one tick program, one process.
+
+``ClusterSim`` is the 100–1000-validator simulation engine ROADMAP item 3
+asked for: every engine multicasts into its
+:class:`~go_ibft_tpu.net.ici.IciLockstepTransport` outbox, the driver
+runs the tick collective, flushes every engine's
+:class:`~go_ibft_tpu.core.transport.BatchingIngress` synchronously
+(calibration off — deterministic windows), and yields so the engines
+react before the next tick.  Heights run behind a barrier, exactly like
+the loopback harness, so the two transports see the same per-height
+message population and the finalized chains can be compared byte for
+byte.
+
+``LoopbackClusterSim`` is that baseline: per-message gossip fanned into
+every engine's ``add_message`` — the tests/harness shape — at matched
+cluster size, used both as the chain ORACLE (same
+:class:`~go_ibft_tpu.sim.backend.SimBackend` determinism) and as the
+timing comparison for bench config #15.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from ..core import IBFT
+from ..core.transport import BatchingIngress
+from ..net.ici import IciLockstepTransport
+from ..obs import gates
+from .backend import SimBackend, sim_address
+
+
+class _NullLogger:
+    def info(self, *a):
+        pass
+
+    debug = info
+    error = info
+
+
+@dataclass
+class ClusterResult:
+    """One cluster run's outcome (chains are raw finalized proposals)."""
+
+    transport: str
+    nodes: int
+    heights: int
+    chains: List[List[bytes]]
+    elapsed_s: float
+    ticks: int = 0
+    messages: int = 0
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def heights_per_s(self) -> float:
+        return self.heights / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+    @property
+    def messages_per_tick(self) -> float:
+        return self.messages / self.ticks if self.ticks else 0.0
+
+    def missed_heights(self, participants: Optional[Sequence[int]] = None) -> int:
+        nodes = range(self.nodes) if participants is None else participants
+        return sum(max(0, self.heights - len(self.chains[i])) for i in nodes)
+
+    def diverged_chains(self, participants: Optional[Sequence[int]] = None) -> int:
+        """Nodes whose chain is not a prefix-consistent view of the
+        longest participant chain (byte comparison, not length)."""
+        nodes = list(range(self.nodes) if participants is None else participants)
+        if not nodes:
+            return 0
+        reference = max((self.chains[i] for i in nodes), key=len)
+        return sum(
+            1
+            for i in nodes
+            if self.chains[i] != reference[: len(self.chains[i])]
+        )
+
+    def slo_records(
+        self, participants: Optional[Sequence[int]] = None
+    ) -> List[dict]:
+        """``missed_heights`` / ``diverged_chains`` records for
+        :func:`go_ibft_tpu.obs.gates.gate_slo_records` — a cluster soak
+        fails CI exactly like a perf regression."""
+        ctx = {"transport": self.transport, "nodes": self.nodes,
+               "heights": self.heights}
+        return [
+            gates.slo_record(
+                "missed_heights", self.missed_heights(participants),
+                context=ctx,
+            ),
+            gates.slo_record(
+                "diverged_chains", self.diverged_chains(participants),
+                context=ctx,
+            ),
+        ]
+
+
+class ClusterSim:
+    """N engines mounted lock-step on one ICI hub (one-shot: build,
+    :meth:`run` once, read the result)."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        *,
+        devices=None,
+        max_msgs: int = 8,
+        max_bytes: int = 1024,
+        round_timeout: float = 0.15,
+        chaos=None,
+        verifier=None,
+        logger=None,
+    ) -> None:
+        self.n_nodes = n_nodes
+        addresses = [sim_address(i) for i in range(n_nodes)]
+        self.hub = IciLockstepTransport(
+            n_nodes,
+            devices=devices,
+            max_msgs=max_msgs,
+            max_bytes=max_bytes,
+            logger=logger,
+            verifier=verifier,
+            chaos=chaos,
+        )
+        log = logger or _NullLogger()
+        self.backends: List[SimBackend] = []
+        self.engines: List[IBFT] = []
+        self.ingresses: List[BatchingIngress] = []
+        for i in range(n_nodes):
+            backend = SimBackend(i, addresses)
+            engine = IBFT(
+                log,
+                backend,
+                self.hub.port(i),
+                batch_verifier=(
+                    self.hub.tick_verifier() if verifier is not None else None
+                ),
+            )
+            engine.set_base_round_timeout(round_timeout)
+            ingress = BatchingIngress(engine.add_messages, calibrate=False)
+            self.hub.register(self._sink(ingress))
+            self.backends.append(backend)
+            self.engines.append(engine)
+            self.ingresses.append(ingress)
+
+    @staticmethod
+    def _sink(ingress: BatchingIngress):
+        def deliver(batch):
+            for m in batch:
+                ingress.submit(m)
+
+        return deliver
+
+    async def _drive(
+        self, tasks, required: Sequence[int], deadline_s: float
+    ) -> bool:
+        """Tick until every required task finishes (True) or the deadline
+        passes (False).  One :meth:`hub.step` + synchronous ingress
+        flushes + a few cooperative yields per iteration; idle ticks
+        sleep a hair of wall clock so round timers can fire."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + deadline_s
+        while not all(tasks[i].done() for i in required):
+            if loop.time() > deadline:
+                return False
+            await asyncio.sleep(0)
+            self.hub.step()
+            for ingress in self.ingresses:
+                ingress.flush()
+            for _ in range(4):
+                await asyncio.sleep(0)
+            if self.hub.idle():
+                await asyncio.sleep(0.0005)
+        return True
+
+    async def run(
+        self,
+        heights: int,
+        *,
+        participants: Optional[Sequence[int]] = None,
+        height_timeout: float = 30.0,
+    ) -> ClusterResult:
+        required = list(
+            range(self.n_nodes) if participants is None else participants
+        )
+        t0 = time.perf_counter()
+        for h in range(heights):
+            tasks = [
+                asyncio.get_running_loop().create_task(
+                    engine.run_sequence(h), name=f"sim-seq-{i}-h{h}"
+                )
+                for i, engine in enumerate(self.engines)
+            ]
+            try:
+                await self._drive(tasks, required, height_timeout)
+            finally:
+                for task in tasks:
+                    if not task.done():
+                        task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.perf_counter() - t0
+        for ingress in self.ingresses:
+            ingress.close()
+        stats = self.hub.stats()
+        return ClusterResult(
+            transport="lockstep",
+            nodes=self.n_nodes,
+            heights=heights,
+            chains=[b.chain for b in self.backends],
+            elapsed_s=elapsed,
+            ticks=stats["ticks"],
+            messages=stats["delivered"],
+            stats=stats,
+        )
+
+    def run_sync(self, heights: int, **kw) -> ClusterResult:
+        return asyncio.run(self.run(heights, **kw))
+
+
+class LoopbackClusterSim:
+    """The threaded-loopback baseline at matched size: per-message gossip
+    into every engine's ``add_message`` (the tests/harness shape)."""
+
+    def __init__(self, n_nodes: int, *, round_timeout: float = 0.15) -> None:
+        self.n_nodes = n_nodes
+        addresses = [sim_address(i) for i in range(n_nodes)]
+        self.backends = [SimBackend(i, addresses) for i in range(n_nodes)]
+        self.engines: List[IBFT] = []
+        for backend in self.backends:
+            engine = IBFT(_NullLogger(), backend, self._port())
+            engine.set_base_round_timeout(round_timeout)
+            self.engines.append(engine)
+
+    def _port(self):
+        sim = self
+
+        class _T:
+            def multicast(self, message):
+                for engine in sim.engines:
+                    engine.add_message(message)
+
+        return _T()
+
+    async def run(
+        self, heights: int, *, height_timeout: float = 30.0
+    ) -> ClusterResult:
+        t0 = time.perf_counter()
+        for h in range(heights):
+            tasks = [
+                asyncio.get_running_loop().create_task(
+                    engine.run_sequence(h), name=f"loop-seq-{i}-h{h}"
+                )
+                for i, engine in enumerate(self.engines)
+            ]
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*tasks), height_timeout
+                )
+            finally:
+                for task in tasks:
+                    if not task.done():
+                        task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.perf_counter() - t0
+        return ClusterResult(
+            transport="loopback",
+            nodes=self.n_nodes,
+            heights=heights,
+            chains=[b.chain for b in self.backends],
+            elapsed_s=elapsed,
+        )
+
+    def run_sync(self, heights: int, **kw) -> ClusterResult:
+        return asyncio.run(self.run(heights, **kw))
+
+
+def run_matched_pair(
+    n_nodes: int,
+    heights: int,
+    *,
+    devices=None,
+    max_msgs: int = 8,
+    max_bytes: int = 1024,
+    round_timeout: float = 0.15,
+    height_timeout: float = 60.0,
+):
+    """Bench config #15's measurement pair: the SAME workload through the
+    lock-step engine and the threaded-loopback baseline.  Returns
+    ``(lockstep, loopback)`` results; the caller asserts chain identity
+    (the oracle gate) before publishing any timing."""
+    lock = ClusterSim(
+        n_nodes,
+        devices=devices,
+        max_msgs=max_msgs,
+        max_bytes=max_bytes,
+        round_timeout=round_timeout,
+    ).run_sync(heights, height_timeout=height_timeout)
+    loop = LoopbackClusterSim(
+        n_nodes, round_timeout=round_timeout
+    ).run_sync(heights, height_timeout=height_timeout)
+    return lock, loop

@@ -1551,6 +1551,47 @@ class DeviceBatchVerifier:
                     out[np.asarray(chunk)] = mask[: len(chunk)]
         return out
 
+    def verify_sender_rows(
+        self,
+        height: int,
+        zw: np.ndarray,
+        r: np.ndarray,
+        s: np.ndarray,
+        v: np.ndarray,
+        claimed: np.ndarray,
+        live: np.ndarray,
+    ) -> np.ndarray:
+        """Pre-digested rows -> per-lane sender-validity mask.
+
+        The ICI tick drain (:meth:`go_ibft_tpu.net.ici
+        .IciLockstepTransport.step`): the tick program already computed
+        the payload digests on-device and gathered the
+        signature/claimed-address rows, so this is ONE recover dispatch
+        per call — no decode→re-encode→re-pack round trip.  ``zw`` is
+        ``(n, 8)`` little-endian digest words; the remaining arrays
+        follow :func:`pack_sender_batch` row layout."""
+        n = int(zw.shape[0])
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        bb = _lane_count(n, self._pad_lanes(n))
+        if bb > n:
+            pad = bb - n
+            zw = np.concatenate([zw, np.zeros((pad,) + zw.shape[1:], zw.dtype)])
+            r = np.concatenate([r, np.zeros((pad,) + r.shape[1:], r.dtype)])
+            s = np.concatenate([s, np.zeros((pad,) + s.shape[1:], s.dtype)])
+            v = np.concatenate([v, np.zeros((pad,), v.dtype)])
+            claimed = np.concatenate(
+                [claimed, np.zeros((pad,) + claimed.shape[1:], claimed.dtype)]
+            )
+            live = np.concatenate([live, np.zeros((pad,), dtype=bool)])
+        mask, _ = self._dispatch(
+            (zw, r, s, v, claimed, live),
+            self._table_dev(height),
+            None,
+            "verify_sender_rows_ms",
+        )
+        return np.asarray(mask[:n], dtype=bool)
+
     def verify_committed_seals(
         self, proposal_hash: bytes, seals: Sequence[CommittedSeal], height: int
     ) -> np.ndarray:

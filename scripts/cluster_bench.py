@@ -1,154 +1,107 @@
-"""End-to-end cluster throughput: N validators finalizing H heights.
+"""Thin driver for the cluster simulation engine (bench config #15).
 
-The engine-level complement to bench.py's kernel-level configs: spins up a
-full in-process cluster (every node runs the real asyncio state machine)
-and measures heights/sec over either transport backend:
+The measurement of record is ``python bench.py --cluster-only`` (the
+`make cluster-bench` entry point): oracle-gated chain identity, the >=3x
+lock-step vs loopback bar, the 1000-validator one-dispatch structural
+tick, and the evidence/ledger plumbing all live there.  This script is
+the exploratory complement — one cluster, one transport, one JSON line —
+for quick sweeps (``--nodes 256 --heights 3``) and chaos-schedule
+spot-checks (``--seed`` prints the CHAOS-REPLAY line) without the bench
+contract's budget machinery.
 
-* ``loopback``   — direct in-process multicast (the reference's test
-                   topology, go-ibft core/helpers_test.go:227-231);
-* ``ici``        — the lock-step collective transport: one validator per
-                   mesh device, multicast = one fixed-shape all_gather per
-                   step (needs >= N devices; on CPU set
-                   ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+Usage: ``python scripts/cluster_bench.py [--nodes 100] [--heights 5]
+[--transport lockstep|loopback] [--seed N] [--drop-rate 0.05]
+[--round-timeout 5.0]``
 
-Usage: ``python scripts/cluster_bench.py [--nodes 4] [--heights 5]
-[--transport loopback|ici] [--crypto]``
-
-``--crypto`` switches the mock backend for real ECDSA signing/verification
-(host path; attach a device verifier through bench.py's configs instead
-when measuring kernels — this script measures the *consensus runtime*).
+On CPU, export ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+first (the Makefile target does) so the lock-step hub shards across
+virtual devices instead of degrading to the host route.
 """
 
 import argparse
-import asyncio
 import json
 import sys
-import time
 
 sys.path.insert(0, ".")
-sys.path.insert(0, "tests")
-
-
-def _build_engines(n: int, crypto: bool):
-    from go_ibft_tpu.core import IBFT
-
-    if crypto:
-        from go_ibft_tpu.crypto import PrivateKey
-        from go_ibft_tpu.crypto.backend import ECDSABackend
-
-        keys = [PrivateKey.from_seed(b"cluster-bench-%d" % i) for i in range(n)]
-        src = ECDSABackend.static_validators({k.address: 1 for k in keys})
-        backends = [ECDSABackend(k, src) for k in keys]
-    else:
-        from harness import MockBackend
-
-        class _Shim:
-            def __init__(self, addresses):
-                self.addresses = list(addresses)
-
-                class _N:
-                    def __init__(self, a):
-                        self.address = a
-
-                self.nodes = [_N(a) for a in self.addresses]
-
-            def proposer_for(self, height, round_):
-                return self.addresses[(height + round_) % len(self.addresses)]
-
-        shim = _Shim([b"node-%02d-pad-pad-pad" % i for i in range(n)])
-        backends = [MockBackend(a, shim) for a in shim.addresses]
-
-    class _Null:
-        def info(self, *a):
-            pass
-
-        debug = error = info
-
-    engines = []
-    for b in backends:
-        e = IBFT(_Null(), b, None)
-        e.set_base_round_timeout(10.0)
-        engines.append(e)
-    return engines
-
-
-async def _run(engines, heights: int, transport: str) -> float:
-    from go_ibft_tpu.core.transport import LoopbackTransport
-
-    hub = None
-    if transport == "ici":
-        from go_ibft_tpu.net import IciLockstepTransport
-
-        hub = IciLockstepTransport(len(engines), step_interval=0.001)
-        for e in engines:
-            e.transport = hub.register(e.add_messages)
-        hub.start()
-    else:
-        loop = LoopbackTransport()
-        for e in engines:
-            loop.register(e.add_message)
-            e.transport = loop
-
-    t0 = time.perf_counter()
-    try:
-        for h in range(1, heights + 1):
-            await asyncio.wait_for(
-                asyncio.gather(*(e.run_sequence(h) for e in engines)), 120
-            )
-    finally:
-        if hub is not None:
-            await hub.stop()
-        for e in engines:
-            e.messages.close()
-    elapsed = time.perf_counter() - t0
-    for e in engines:
-        assert len(e.backend.inserted) == heights, "a node missed a height"
-    return elapsed
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--heights", type=int, default=5)
-    ap.add_argument("--transport", choices=("loopback", "ici"), default="loopback")
-    ap.add_argument("--crypto", action="store_true")
     ap.add_argument(
-        "--platform",
-        default=None,
-        help="pin the jax platform (e.g. cpu); for --transport ici on CPU "
-        "this also forces nodes-many virtual devices.  Env vars are not "
-        "authoritative in containers with a sitecustomize hook — only "
-        "jax.config.update before backend init works.",
+        "--transport", choices=("lockstep", "loopback"), default="lockstep"
     )
+    ap.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="enable the chaos mask plane with this seed (lock-step only); "
+        "prints the run's CHAOS-REPLAY line",
+    )
+    ap.add_argument("--drop-rate", type=float, default=0.05)
+    ap.add_argument(
+        "--round-timeout",
+        type=float,
+        default=5.0,
+        help="engine round timeout; at 100+ nodes keep this generous so "
+        "the clean path stays on round 0 (docs/CLUSTER.md)",
+    )
+    ap.add_argument("--max-msgs", type=int, default=8)
+    ap.add_argument("--max-bytes", type=int, default=1024)
     args = ap.parse_args()
 
-    if args.platform or args.transport == "ici":
-        import jax
+    from go_ibft_tpu.sim import ChaosMask, ClusterSim, LoopbackClusterSim
 
-        try:
-            jax.config.update("jax_platforms", args.platform or "cpu")
-            if (args.platform or "cpu") == "cpu":
-                jax.config.update("jax_num_cpu_devices", args.nodes)
-        except RuntimeError:
-            pass  # backend already initialized; keep whatever is live
-
-    engines = _build_engines(args.nodes, args.crypto)
-    elapsed = asyncio.run(_run(engines, args.heights, args.transport))
-    print(
-        json.dumps(
-            {
-                "metric": "cluster_heights_per_sec",
-                "value": round(args.heights / elapsed, 2),
-                "unit": "heights/sec",
-                "vs_baseline": None,
-                "nodes": args.nodes,
-                "heights": args.heights,
-                "transport": args.transport,
-                "crypto": bool(args.crypto),
-                "elapsed_s": round(elapsed, 3),
-            }
+    chaos = None
+    if args.seed is not None:
+        # Loss confined to a minority of receivers keeps the connected
+        # majority's liveness provable (go_ibft_tpu/sim/chaos.py).
+        lossy = tuple(range(max(1, args.nodes // 10)))
+        chaos = ChaosMask(
+            args.nodes, seed=args.seed,
+            drop_rate=args.drop_rate, lossy=lossy,
         )
+
+    if args.transport == "lockstep":
+        sim = ClusterSim(
+            args.nodes,
+            max_msgs=args.max_msgs,
+            max_bytes=args.max_bytes,
+            round_timeout=args.round_timeout,
+            chaos=chaos,
+        )
+    else:
+        sim = LoopbackClusterSim(
+            args.nodes, round_timeout=args.round_timeout
+        )
+    participants = (
+        None
+        if chaos is None
+        else [i for i in range(args.nodes) if i not in set(chaos.lossy)]
     )
+    kw = {} if args.transport == "loopback" else {"participants": participants}
+    result = sim.run_sync(args.heights, **kw)
+
+    line = {
+        "metric": "cluster_heights_per_sec",
+        "value": round(result.heights_per_s, 2),
+        "unit": "heights/sec",
+        "vs_baseline": None,
+        "nodes": result.nodes,
+        "heights": result.heights,
+        "transport": result.transport,
+        "ticks": result.ticks,
+        "messages_per_tick": round(result.messages_per_tick, 1),
+        "missed_heights": result.missed_heights(participants),
+        "diverged_chains": result.diverged_chains(participants),
+        "elapsed_s": round(result.elapsed_s, 3),
+        "note": "exploratory sweep; the contract run is "
+        "`python bench.py --cluster-only` (make cluster-bench)",
+    }
+    if chaos is not None:
+        line["chaos_replay"] = chaos.replay_line(result.ticks)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

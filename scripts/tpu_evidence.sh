@@ -10,6 +10,12 @@
 #   3. the bench matrix last — it records the headline + calibration.
 #
 # Every step appends JSON lines to $OUT (default evidence_tpu.jsonl).
+#
+# Standing items (run when chip time allows, not yet wired as steps):
+#   - on-chip ici tick capture: `python bench.py --cluster-only
+#     --device-trace ici_tick_prof` on a real mesh — the lock-step
+#     gather over ICI (not host-shard loopback) is the number the
+#     cluster engine's 3x CPU evidence stands in for (ISSUE 17).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="${OUT:-evidence_tpu.jsonl}"

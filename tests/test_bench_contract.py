@@ -412,6 +412,22 @@ def test_multipair_only_flag_scopes_evidence_contract():
     assert "config13_multipair" in src
 
 
+def test_cluster_only_flag_scopes_evidence_contract():
+    """`bench.py --cluster-only` (the make cluster-bench entry) runs
+    ONLY config #15 and scopes the rc=0 evidence contract to it — static
+    check on _run, like the other --*-only pins.  Config #15 is NOT in
+    the driver-conditions measured set: under the 480 s budget it skips
+    with an honest evidence line (config #14 precedent) and the scoped
+    entry point is where it measures."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "cluster_only" in src
+    assert "config15_cluster" in src
+
+
 def test_serve_only_flag_scopes_evidence_contract():
     """`bench.py --serve-only` (the make serve-bench entry) runs ONLY
     config #12 and scopes the rc=0 evidence contract to it — static

@@ -1,0 +1,305 @@
+"""Lock-step cluster engine (ISSUE 17): the ICI tick collective as the
+100–1000-validator simulation engine.
+
+Pins the tentpole contracts:
+
+* matched lock-step vs loopback runs finalize byte-identical chains
+  (sim crypto at 4 and 100 validators; REAL ECDSA with the tick-fused
+  rows verifier at 4 validators on the forced-host device mesh);
+* one consensus tick is ONE collective dispatch (cost-ledger pin);
+* the chaos plane is a pure function of ``(seed, tick)`` — identical
+  edge masks, schedule digests, and replay lines per seed — and a
+  seeded 100-validator run with drops plus a partition epoch still
+  finalizes every height for the connected majority, byte-identically
+  across replays;
+* the tier-1 100-validator/10-height soak feeds ``missed_heights`` /
+  ``diverged_chains`` through the obs/gates SLO table (divergence is a
+  CI failure, not a log line).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.core import IBFT, BatchingIngress
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto.backend import ECDSABackend
+from go_ibft_tpu.messages import View
+from go_ibft_tpu.net import IciLockstepTransport
+from go_ibft_tpu.net.ici import TICK_PROGRAM
+from go_ibft_tpu.obs import gates
+from go_ibft_tpu.obs import ledger as cost_ledger
+from go_ibft_tpu.sim import (
+    ChaosMask,
+    ClusterSim,
+    SimBackend,
+    run_matched_pair,
+    sim_address,
+    sim_block,
+    sim_hash,
+)
+from go_ibft_tpu.verify import DeviceBatchVerifier
+
+from harness import NullLogger, TEST_ROUND_TIMEOUT
+
+
+@pytest.fixture(autouse=True)
+def _ledger_reset():
+    cost_ledger.disable()
+    yield
+    cost_ledger.disable()
+
+
+# ---------------------------------------------------------------------------
+# chain-identity parity (the bench config #15 oracle, in miniature)
+# ---------------------------------------------------------------------------
+
+
+def test_matched_pair_chains_identical_4v():
+    lock, loop = run_matched_pair(4, 3, round_timeout=1.0)
+    expected = [sim_block(h) for h in range(3)]
+    assert lock.chains == [expected] * 4
+    assert lock.chains == loop.chains
+    assert lock.ticks > 0 and lock.messages > 0
+
+
+async def test_real_crypto_lockstep_matches_loopback_4v():
+    """Forced-host multi-device mesh (conftest pins 8 virtual devices →
+    a 4-node node-axis mesh), REAL ECDSA envelopes, sender validity
+    resolved from the tick program's gathered digest/claimed-address
+    rows via :class:`TickVerdictVerifier` — finalized chains must match
+    a loopback run of the same keys byte for byte."""
+    n, heights = 4, (1, 2)
+    keys = [PrivateKey.from_seed(b"ici-crypto-%d" % i) for i in range(n)]
+    src = ECDSABackend.static_validators({k.address: 1 for k in keys})
+    verifier = DeviceBatchVerifier(src)
+    verifier.warmup()
+
+    hub = IciLockstepTransport(n, max_bytes=4096, verifier=verifier)
+    assert hub.devices == 4 and hub.stats()["route"] == "device"
+    engines, ingresses = [], []
+    for i in range(n):
+        engine = IBFT(
+            NullLogger(),
+            ECDSABackend(keys[i], src),
+            hub.port(i),
+            batch_verifier=hub.tick_verifier(),
+        )
+        engine.set_base_round_timeout(TEST_ROUND_TIMEOUT * 40)
+        ingress = BatchingIngress(engine.add_messages, calibrate=False)
+        hub.register(
+            lambda batch, ing=ingress: [ing.submit(m) for m in batch]
+        )
+        engines.append(engine)
+        ingresses.append(ingress)
+
+    async def drive(tasks, deadline_s=240.0):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + deadline_s
+        while not all(t.done() for t in tasks):
+            assert loop.time() < deadline, "lock-step drive timed out"
+            await asyncio.sleep(0)
+            hub.step()
+            for ing in ingresses:
+                ing.flush()
+            for _ in range(4):
+                await asyncio.sleep(0)
+            if hub.idle():
+                await asyncio.sleep(0.0005)
+
+    try:
+        for h in heights:
+            tasks = [
+                asyncio.create_task(e.run_sequence(h)) for e in engines
+            ]
+            try:
+                await drive(tasks)
+            finally:
+                for t in tasks:
+                    if not t.done():
+                        t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+    finally:
+        for ing in ingresses:
+            ing.close()
+
+    # Loopback oracle: same keys, same heights, harness gossip shape
+    # (per-message add_message, host-path sender validation).
+    loop_engines = []
+
+    class _LoopT:
+        def multicast(self, message):
+            for e in loop_engines:
+                e.add_message(message)
+
+    for i in range(n):
+        e = IBFT(NullLogger(), ECDSABackend(keys[i], src), _LoopT())
+        e.set_base_round_timeout(TEST_ROUND_TIMEOUT * 40)
+        loop_engines.append(e)
+    for h in heights:
+        tasks = [
+            asyncio.create_task(e.run_sequence(h)) for e in loop_engines
+        ]
+        try:
+            await asyncio.wait_for(asyncio.gather(*tasks), 240.0)
+        finally:
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    def chain(engine):
+        return [p.raw_proposal for p, _ in engine.backend.inserted]
+
+    assert [chain(e) for e in engines] == [chain(e) for e in loop_engines]
+    assert chain(engines[0]) == [b"block 1", b"block 2"]
+    assert hub.stats()["bad_slots"] == 0
+
+
+# ---------------------------------------------------------------------------
+# one tick == one collective dispatch
+# ---------------------------------------------------------------------------
+
+
+def _tick_dispatches() -> int:
+    snap = cost_ledger.snapshot() or {"dispatches": ()}
+    return sum(
+        r["dispatches"]
+        for r in snap["dispatches"]
+        if r["program"] == TICK_PROGRAM
+    )
+
+
+def test_tick_collective_is_one_dispatch():
+    cost_ledger.enable()
+    n = 4
+    hub = IciLockstepTransport(n, max_msgs=4)
+    for _ in range(n):
+        hub.register(lambda batch: None)
+    assert hub.stats()["route"] == "device"
+    addrs = [sim_address(i) for i in range(n)]
+    view = View(height=0, round=0)
+    phash = sim_hash(sim_block(0))
+    for i in range(n):
+        hub.port(i).multicast(
+            SimBackend(i, addrs).build_prepare_message(phash, view)
+        )
+    before = _tick_dispatches()
+    hub.step()
+    assert _tick_dispatches() - before == 1, (
+        "a tick with every outbox occupied must be ONE collective dispatch"
+    )
+    assert hub.stats()["delivered"] == n * n
+    # An idle tick never dispatches at all.
+    before = _tick_dispatches()
+    hub.step()
+    assert _tick_dispatches() - before == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos plane: pure function of (seed, tick)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_mask_deterministic_per_seed_and_seed_sensitive():
+    kw = dict(
+        drop_rate=0.3,
+        lossy=range(5),
+        delay_max=2,
+        partition=(2, 5, (range(0, 12), range(12, 20))),
+    )
+    a = ChaosMask(20, seed=7, **kw)
+    b = ChaosMask(20, seed=7, **kw)
+    for t in (0, 1, 3, 9):
+        allow_a, delay_a = a.edges(t)
+        allow_b, delay_b = b.edges(t)
+        assert np.array_equal(allow_a, allow_b)
+        assert np.array_equal(delay_a, delay_b)
+    assert a.schedule_digest(12) == b.schedule_digest(12)
+    assert a.replay_line(12) == b.replay_line(12)
+    assert (
+        a.schedule_digest(12) != ChaosMask(20, seed=8, **kw).schedule_digest(12)
+    )
+    # The partition epoch cuts cross-group edges both ways; self-edges
+    # and non-lossy same-group edges survive everything.
+    allow, _ = a.edges(3)
+    assert not allow[0, 12] and not allow[12, 0]
+    assert allow.diagonal().all()
+    allow0, delay0 = a.edges(0)  # outside the epoch
+    assert allow0[:, 5:].all(), "drops must stay confined to lossy receivers"
+    assert (delay0[:, 5:] == 0).all()
+
+
+def test_chaos_100v_majority_finalizes_and_replays_byte_identically():
+    """Seeded drops into a lossy minority + one partition epoch: the
+    connected majority finalizes every height; a second run from the
+    same seed reproduces the majority chains and the schedule digest
+    byte for byte (the CHAOS-REPLAY contract)."""
+    majority = list(range(80))
+
+    def run(seed):
+        chaos = ChaosMask(
+            100,
+            seed=seed,
+            drop_rate=0.1,
+            lossy=tuple(range(90, 100)),
+            partition=(6, 14, (tuple(range(80)), tuple(range(80, 100)))),
+        )
+        sim = ClusterSim(100, round_timeout=5.0, chaos=chaos)
+        result = sim.run_sync(
+            5, participants=majority, height_timeout=120.0
+        )
+        return chaos, result
+
+    chaos_a, a = run(1234)
+    assert a.missed_heights(majority) == 0
+    assert a.diverged_chains(majority) == 0
+    expected = [sim_block(h) for h in range(5)]
+    assert all(a.chains[i] == expected for i in majority)
+    assert a.stats["dropped_chaos"] > 0, "the mask must actually cut edges"
+
+    chaos_b, b = run(1234)
+    assert [b.chains[i] for i in majority] == [a.chains[i] for i in majority]
+    ticks = max(a.ticks, b.ticks)
+    assert chaos_a.schedule_digest(ticks) == chaos_b.schedule_digest(ticks)
+    assert chaos_a.replay_line(ticks) == chaos_b.replay_line(ticks)
+
+
+# ---------------------------------------------------------------------------
+# SLO soak (tier-1) + the slow 1000-validator smoke
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_soak_100v_10h_slo_gates():
+    result = ClusterSim(100, round_timeout=5.0).run_sync(
+        10, height_timeout=120.0
+    )
+    records = result.slo_records()
+    graded = gates.gate_slo_records(records)
+    assert [g.status for g in graded] == ["pass", "pass"], [
+        (g.config, g.status) for g in graded
+    ]
+    assert result.missed_heights() == 0
+    assert result.diverged_chains() == 0
+    assert result.chains[0] == [sim_block(h) for h in range(10)]
+
+
+def test_divergence_fails_the_slo_gate():
+    graded = gates.gate_slo_records(
+        [
+            gates.slo_record("diverged_chains", 1),
+            gates.slo_record("missed_heights", 2),
+        ]
+    )
+    assert [g.status for g in graded] == ["fail", "fail"]
+
+
+@pytest.mark.slow
+def test_cluster_1000v_smoke():
+    result = ClusterSim(
+        1000, round_timeout=30.0, max_msgs=4, max_bytes=1024
+    ).run_sync(1, height_timeout=900.0)
+    assert result.missed_heights() == 0
+    assert result.diverged_chains() == 0
+    assert result.chains[0] == [sim_block(0)]

@@ -107,6 +107,70 @@ async def test_corrupt_slot_does_not_poison_batch(monkeypatch):
     assert got[0].prepare_data.proposal_hash == _msg(1).prepare_data.proposal_hash
 
 
+async def test_oversize_drops_counted_in_stats_and_metrics():
+    from go_ibft_tpu.utils import metrics
+
+    key = ("go-ibft", "ici", "dropped_oversize")
+    base = metrics.get_counter(key)
+    log = _Log()
+    hub = _hub(2, max_bytes=64, logger=log)
+    port = hub.register(lambda b: None)
+    hub.register(lambda b: None)
+    port.multicast(_msg(0))
+    port.multicast(_msg(1))
+    # Accounted at ENQUEUE time — no tick needed to observe the loss.
+    assert hub.stats()["dropped_oversize"] == 2
+    assert metrics.get_counter(key) - base == 2
+    assert len(log.errors) == 2
+
+
+async def test_overflow_drops_oldest_at_enqueue_and_counts():
+    from go_ibft_tpu.utils import metrics
+
+    key = ("go-ibft", "ici", "dropped_overflow")
+    base = metrics.get_counter(key)
+    log = _Log()
+    hub = _hub(2, max_msgs=2, logger=log)
+    got = []
+    port = hub.register(got.extend)
+    hub.register(lambda b: None)
+    for i in range(5):
+        port.multicast(_msg(i, payload=bytes([i]) * 32))
+    # Drop-oldest happens as each overflowing message arrives, so the
+    # accounting is visible BEFORE the tick runs.
+    assert hub.stats()["dropped_overflow"] == 3
+    assert metrics.get_counter(key) - base == 3
+    hub.step()
+    assert hub.stats()["sent"] == 5
+    assert hub.stats()["delivered"] == 4  # 2 surviving slots x 2 receivers
+    assert [m.prepare_data.proposal_hash[0] for m in got] == [3, 4]
+
+
+async def test_bad_slot_quarantine_counted(monkeypatch):
+    from go_ibft_tpu.utils import metrics
+
+    key = ("go-ibft", "ici", "bad_slot")
+    base = metrics.get_counter(key)
+    log = _Log()
+    hub = _hub(2, logger=log)
+    got = []
+    port = hub.register(got.extend)
+    hub.register(lambda b: None)
+    port.multicast(_msg(0))
+    orig_pack = hub._pack
+
+    def corrupting_pack():
+        out = orig_pack()
+        out[0, 0, 4:20] = 0xFF
+        return out
+
+    monkeypatch.setattr(hub, "_pack", corrupting_pack)
+    hub.step()
+    assert got == []
+    assert hub.stats()["bad_slots"] == 1
+    assert metrics.get_counter(key) - base == 1
+
+
 async def test_register_beyond_capacity_raises():
     hub = _hub(2)
     hub.register(lambda b: None)

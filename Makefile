@@ -4,7 +4,7 @@
 	warm cluster-bench cluster-soak obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
 	serve-bench timeline-smoke slo-gates multipair-bench cost-report \
-	boot-bench boot-check
+	boot-bench boot-check byzantine-smoke byzantine-soak
 
 test:
 	python -m pytest tests/ -q
@@ -183,6 +183,29 @@ cluster-bench:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	GO_IBFT_BENCH_BUDGET_S=600 \
 	python bench.py --cluster-only
+
+# Byzantine adversary smoke (config #16, fast-tier CI): one 100-
+# validator lock-step cluster over the wan3 geo-latency preset, run
+# clean then degraded by a seeded 30%-power strategy mix (equivocating
+# proposers, COMMIT withholders, round-change spammers, stale-height
+# replayers) with the invariant harness checking agreement / validity /
+# bounded-rounds-after-GST on every tick of both runs.  Any violation
+# or missed honest height fails; the printed CHAOS-REPLAY line re-runs
+# the exact scenario via scripts/chaos_replay.py --line.
+# GO_IBFT_BYZ_NODES / _HEIGHTS / _SEED / _POWER / _PRESET scale it.
+byzantine-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --byzantine-only
+
+# Slow-tier byzantine soak: 3 seeds x the full strategy matrix at 12
+# validators over WAN chaos, every invariant checked every tick
+# (tests/test_adversary.py slow tier)
+byzantine-soak:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	python -m pytest tests/test_adversary.py -q -m slow
 
 # Slow-tier cluster soak: the 1000-validator lock-step smoke plus the
 # seeded 100-validator chaos-mask runs (tests/test_cluster_sim.py)

@@ -3130,6 +3130,139 @@ def config15_cluster() -> None:
     )
 
 
+def config16_byzantine_soak() -> None:
+    """Byzantine soak (config #16): a 100-validator lock-step cluster
+    over a WAN geo-latency preset, run twice — clean (WAN chaos only)
+    and degraded (same chaos plus a seeded 30%-power adversary mix:
+    equivocating proposers, COMMIT withholders, round-change spammers,
+    stale-height replayers) — with the invariant harness
+    (sim/invariants.py) checking agreement / validity / bounded-rounds
+    on every tick of BOTH runs.
+
+    Gate order mirrors #15: invariants and liveness gate BEFORE any
+    timing is published (an agreement violation fails the config
+    outright, and the CHAOS-REPLAY line printed above the evidence makes
+    the violating seed replayable via scripts/chaos_replay.py --line).
+    Metric = clean/degraded heights-per-second overhead ratio, also
+    emitted as the ``byzantine_soak_overhead_x`` SLO record so
+    obs/gates.py regression-gates the attack cost.
+    """
+    from go_ibft_tpu.obs import gates
+    from go_ibft_tpu.sim import (
+        AdversaryMix,
+        ClusterSim,
+        cluster_replay_line,
+        wan_mask,
+    )
+
+    nodes = int(os.environ.get("GO_IBFT_BYZ_NODES", "100"))
+    heights = int(os.environ.get("GO_IBFT_BYZ_HEIGHTS", "3"))
+    seed = int(os.environ.get("GO_IBFT_BYZ_SEED", "2026"))
+    power = float(os.environ.get("GO_IBFT_BYZ_POWER", "0.3"))
+    preset = os.environ.get("GO_IBFT_BYZ_PRESET", "wan3")
+    # Short enough that a seeded equivocator holding round 0 costs
+    # seconds, not the budget; long enough that WAN tick delays never
+    # time out an honest round on a loaded CPU host.
+    round_timeout = 2.0
+    # Slots must fit PC-bearing round-change messages or a forced round
+    # change wedges on silent oversize drops (docs/ROBUSTNESS.md).
+    max_bytes = 8192
+
+    def _soak(mix):
+        chaos = wan_mask(preset, nodes, seed=seed)
+        sim = ClusterSim(
+            nodes,
+            round_timeout=round_timeout,
+            max_bytes=max_bytes,
+            chaos=chaos,
+            adversaries=mix,
+            monitor=True,
+        )
+        result = sim.run_sync(heights, height_timeout=180.0)
+        return sim, result, chaos
+
+    # Warm the tick program at the measured (N, M, B) shape (same
+    # posture as #15: the timed runs must not pay the XLA compile).
+    ClusterSim(
+        nodes, round_timeout=round_timeout, max_bytes=max_bytes
+    ).run_sync(1, height_timeout=120.0)
+
+    clean_sim, clean, _ = _soak(None)
+    mix = AdversaryMix.seeded(nodes, seed, power=power)
+    adv_sim, degraded, chaos = _soak(mix)
+
+    replay = cluster_replay_line(
+        chaos,
+        mix,
+        degraded.ticks,
+        heights,
+        max_bytes=max_bytes,
+        round_timeout=round_timeout,
+    )
+    print(replay, flush=True)
+
+    # Invariant + liveness gate BEFORE timing: any violation (or missed
+    # height on an honest node) fails the config.
+    records = []
+    for sim_, result_, label in (
+        (clean_sim, clean, "clean"),
+        (adv_sim, degraded, "degraded"),
+    ):
+        missed = result_.missed_heights(sim_.honest)
+        assert missed == 0, (
+            f"{label} run missed {missed} honest heights — replay with: "
+            f"{replay}"
+        )
+        summary = sim_.monitor.summary()
+        assert summary["ok"], (
+            f"{label} run violated invariants {summary['violations']} — "
+            f"replay with: {replay}"
+        )
+        records.extend(
+            sim_.monitor.slo_records(context={"run": label, "nodes": nodes})
+        )
+        records.extend(result_.slo_records(sim_.honest))
+
+    overhead = (
+        clean.heights_per_s / degraded.heights_per_s
+        if degraded.heights_per_s > 0
+        else float("inf")
+    )
+    records.append(
+        gates.slo_record(
+            "byzantine_soak_overhead_x",
+            round(overhead, 2),
+            context={"seed": seed, "preset": preset, "power": power},
+        )
+    )
+    graded = gates.gate_slo_records(records)
+    slo_failures = [g for g in graded if g.status == "fail"]
+    assert not slo_failures, f"SLO gate failures: {slo_failures}"
+
+    _log(
+        {
+            "metric": config16_byzantine_soak.metric,
+            "value": round(overhead, 2),
+            "unit": "x",
+            "vs_baseline": round(overhead, 2),
+            "baseline": "same WAN cluster with zero adversaries",
+            "variant": "cpu-fallback" if _FALLBACK else "device",
+            "nodes": nodes,
+            "heights": heights,
+            "seed": seed,
+            "preset": preset,
+            "adversary_power": power,
+            "adversaries": mix.config()["adversaries"],
+            "honest_nodes": len(adv_sim.honest),
+            "clean_heights_per_s": round(clean.heights_per_s, 2),
+            "degraded_heights_per_s": round(degraded.heights_per_s, 2),
+            "invariants": adv_sim.monitor.summary(),
+            "dropped_targeted": degraded.stats.get("dropped_targeted", 0),
+            "replay": replay,
+        }
+    )
+
+
 def _guarded(config_fn, failures: list, reserve_s: float = 0.0) -> None:
     """Secondary configs must not take down the headline: report the
     failure as a JSON line and keep going.  The differential smoke and the
@@ -3190,6 +3323,7 @@ config12_proof_serving.metric = "proof_serving_100v"
 config13_multipair.metric = "batched_multipairing_1000c"
 config14_boot_warm_start.metric = "boot_warm_start"
 config15_cluster.metric = "cluster_lockstep_100v"
+config16_byzantine_soak.metric = "byzantine_soak_100v"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -3217,6 +3351,12 @@ _FALLBACK_SCHEDULE = (
     (config11_commit_critical_path, 95.0),
     (config12_proof_serving, 65.0),
     (config13_multipair, 35.0),
+    # Config #16 runs the 100-validator cluster three more times
+    # (warmup + clean + degraded) with the invariant harness scanning
+    # every tick: comparable cost to #15, so the same skip-with-honest-
+    # evidence posture under the tight driver budget; `make
+    # byzantine-smoke` (--byzantine-only) measures it scoped.
+    (config16_byzantine_soak, 460.0),
     # Config #15 runs a 100-validator lock-step cluster three times
     # (warmup + timed) plus the matched loopback baseline and a
     # 1000-validator structural tick: ~30-60 s on XLA:CPU.  Its reserve
@@ -3252,6 +3392,7 @@ _DEVICE_SCHEDULE = (
     (config11_commit_critical_path, 350.0),
     (config12_proof_serving, 330.0),
     (config13_multipair, 310.0),
+    (config16_byzantine_soak, 308.0),
     (config15_cluster, 305.0),
     # Runs last before the headline: its child-process cold compile is
     # the most elastic cost on a live chip, and a skip here (tight
@@ -3391,6 +3532,17 @@ def main(argv=None) -> None:
         "the 1000-validator one-dispatch structural tick; "
         "GO_IBFT_CLUSTER_NODES / GO_IBFT_CLUSTER_HEIGHTS / "
         "GO_IBFT_CLUSTER_STRUCT_NODES scale it)",
+    )
+    parser.add_argument(
+        "--byzantine-only",
+        action="store_true",
+        help="run ONLY the Byzantine soak config (#16); the rc=0 evidence "
+        "contract scopes to it (the `make byzantine-smoke` entry point — "
+        "clean vs 30%%-adversary-power WAN cluster with the invariant "
+        "harness gating agreement/validity/bounded-rounds before the "
+        "overhead ratio is published; GO_IBFT_BYZ_NODES / "
+        "GO_IBFT_BYZ_HEIGHTS / GO_IBFT_BYZ_SEED / GO_IBFT_BYZ_POWER / "
+        "GO_IBFT_BYZ_PRESET scale it)",
     )
     args = parser.parse_args(argv)
     from go_ibft_tpu.obs import ledger as cost_ledger
@@ -3592,6 +3744,21 @@ def _run(args) -> None:
         failures = []
         _guarded(config15_cluster, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config15_cluster.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.byzantine_only:
+        # Scoped run for `make byzantine-smoke`: only config #16, rc=0
+        # iff its evidence line landed.  The config gates every
+        # invariant (and honest liveness) before publishing the
+        # clean-vs-degraded overhead ratio, and prints the CHAOS-REPLAY
+        # line that makes any violation a replayable seed.
+        failures = []
+        _guarded(config16_byzantine_soak, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config16_byzantine_soak.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

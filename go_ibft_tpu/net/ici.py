@@ -142,6 +142,18 @@ class _NodePort:
     def multicast(self, message: IbftMessage) -> None:
         self._hub._enqueue(self._index, message)
 
+    def multicast_to(self, message: IbftMessage, targets) -> None:
+        """Selective-send: deliver only to the ``targets`` node indices.
+
+        The Byzantine strategy seam (sim/adversary.py): an equivocating
+        proposer or COMMIT withholder still rides the SAME staging tensor
+        and tick collective — the target set is applied at the per-edge
+        fan-out where the chaos masks already cut edges, so targeted
+        sends compose with ChaosMask and stay replay-deterministic.
+        Honest engines never call this (the Transport protocol is
+        ``multicast`` only)."""
+        self._hub._enqueue(self._index, message, targets=targets)
+
 
 class TickVerdictVerifier:
     """BatchVerifier facade that consumes the tick program's verdicts.
@@ -223,16 +235,21 @@ class IciLockstepTransport:
             self._sharded = None
             self._route = "host"
         self.devices = d
-        # Outboxes hold (message, wire_bytes): encode once at enqueue,
-        # decode once per live slot at drain — never per receiver.
-        self._outboxes: List[List[Tuple[IbftMessage, bytes]]] = [
-            [] for _ in range(n_nodes)
-        ]
+        # Outboxes hold (message, wire_bytes, targets): encode once at
+        # enqueue, decode once per live slot at drain — never per
+        # receiver.  ``targets`` is None for honest multicast; a
+        # frozenset restricts the fan-out (adversary selective-send).
+        self._outboxes: List[
+            List[Tuple[IbftMessage, bytes, Optional[frozenset]]]
+        ] = [[] for _ in range(n_nodes)]
         self._delivers: List[Callable[[Sequence[IbftMessage]], None]] = []
         self._task: Optional[asyncio.Task] = None
         self._tick = 0
         self._tick_cache: Dict[Tuple, object] = {}
         self._live_entries: List[Tuple[int, IbftMessage]] = []
+        # flat slot -> target node set for this tick's targeted sends
+        # (populated by _pack alongside _live_entries).
+        self._live_targets: Dict[int, frozenset] = {}
         # Delayed chaos lanes: due_tick -> receiver -> [messages].
         self._delayed: Dict[int, Dict[int, List[IbftMessage]]] = {}
         # id(msg) -> (msg, verdict); strong refs pin identity (no GC
@@ -244,6 +261,7 @@ class IciLockstepTransport:
             "dropped_oversize": 0,
             "dropped_overflow": 0,
             "dropped_chaos": 0,
+            "dropped_targeted": 0,
             "bad_slots": 0,
             "last_live": 0,
         }
@@ -302,7 +320,9 @@ class IciLockstepTransport:
 
     # -- data plane -----------------------------------------------------
 
-    def _enqueue(self, index: int, message: IbftMessage) -> None:
+    def _enqueue(
+        self, index: int, message: IbftMessage, targets=None
+    ) -> None:
         box = self._outboxes[index]
         payload = message.encode()
         if len(payload) + _LEN_BYTES > self.max_bytes:
@@ -321,7 +341,9 @@ class IciLockstepTransport:
                 self._log.error(
                     "ici transport: outbox overflow, dropping oldest"
                 )
-        box.append((message, payload))
+        box.append(
+            (message, payload, None if targets is None else frozenset(targets))
+        )
         self._stats["sent"] += 1
 
     def _pack(self) -> Optional[np.ndarray]:
@@ -336,15 +358,19 @@ class IciLockstepTransport:
         lens: List[int] = []
         chunks: List[bytes] = []
         entries: List[Tuple[int, IbftMessage]] = []
+        targets: Dict[int, frozenset] = {}
         for node, box in enumerate(self._outboxes):
-            for slot, (msg, payload) in enumerate(box):
+            for slot, (msg, payload, tgt) in enumerate(box):
                 flat = node * m_slots + slot
                 entries.append((flat, msg))
+                if tgt is not None:
+                    targets[flat] = tgt
                 flats.append(flat)
                 lens.append(len(payload))
                 chunks.append(payload)
             box.clear()
         self._live_entries = entries
+        self._live_targets = targets
         if not entries:
             return None
         staging = np.zeros((n_nodes * m_slots, b), dtype=np.uint8)
@@ -549,8 +575,7 @@ class IciLockstepTransport:
             if rows is not None and gathered_rows is not None:
                 self._drain_rows(rows, gathered_rows, dict(pairs))
         self._stats["last_live"] = len(pairs)
-        batch = [(flat // self.max_msgs, m) for flat, m in pairs]
-        per_receiver = self._apply_chaos(tick, batch, due)
+        per_receiver = self._apply_chaos(tick, pairs, due)
         self._deliver(per_receiver)
 
     def _flush_delayed(self, tick: int) -> Dict[int, List[IbftMessage]]:
@@ -563,29 +588,38 @@ class IciLockstepTransport:
     def _apply_chaos(
         self,
         tick: int,
-        batch: List[Tuple[int, IbftMessage]],
+        pairs: List[Tuple[int, IbftMessage]],
         due: Dict[int, List[IbftMessage]],
     ) -> Dict[int, List[IbftMessage]]:
-        """Fan the gathered ``(sender_node, message)`` batch out per
-        receiver through the chaos masks (drop/partition +
-        delay-in-ticks); pass-through when no chaos plane is mounted."""
+        """Fan the gathered ``(flat_slot, message)`` batch out per
+        receiver through the target sets (adversary selective-send) and
+        the chaos masks (drop/partition + delay-in-ticks); pass-through
+        when neither plane is mounted."""
         n = self.n_nodes
-        if self.chaos is None:
-            if not batch:
+        if self.chaos is None and not self._live_targets:
+            if not pairs:
                 return due
-            msgs = [m for _, m in batch]
+            msgs = [m for _, m in pairs]
             out = dict(due)
             for j in range(n):
                 out[j] = out.get(j, []) + msgs
             return out
-        allow, delay = self.chaos.edges(tick)
+        if self.chaos is not None:
+            allow, delay = self.chaos.edges(tick)
+        else:
+            allow = delay = None
         out = dict(due)
-        for s_i, m in batch:
+        for flat, m in pairs:
+            s_i = flat // self.max_msgs
+            targets = self._live_targets.get(flat)
             for j in range(n):
-                if not allow[s_i, j]:
+                if targets is not None and j not in targets:
+                    self._stats["dropped_targeted"] += 1
+                    continue
+                if allow is not None and not allow[s_i, j]:
                     self._stats["dropped_chaos"] += 1
                     continue
-                d = int(delay[s_i, j])
+                d = int(delay[s_i, j]) if delay is not None else 0
                 if d > 0:
                     self._delayed.setdefault(tick + d, {}).setdefault(
                         j, []

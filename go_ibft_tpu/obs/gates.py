@@ -525,6 +525,20 @@ DEFAULT_SLO_TABLE: Dict[str, SloSpec] = {
     "quarantined_lanes": SloSpec(warn=0, fail=None, unit="lanes"),
     # Sync should only ever repair stranded tails, never carry the soak.
     "sync_fraction": SloSpec(warn=0.25, fail=0.5, unit="fraction"),
+    # Byzantine invariants (sim/invariants.py): a single violation of
+    # agreement, validity, or post-GST bounded-rounds liveness fails the
+    # run — these are the properties the f<N/3 argument promises, and a
+    # violating seed is replayable from its CHAOS-REPLAY line.
+    "invariant_agreement": SloSpec(warn=0, fail=0, unit="violations"),
+    "invariant_validity": SloSpec(warn=0, fail=0, unit="violations"),
+    "invariant_bounded_rounds": SloSpec(warn=0, fail=0, unit="violations"),
+    # Clean/degraded heights-per-second ratio of the Byzantine soak
+    # (bench config #16).  The expensive part is deterministic per seed
+    # — round-timeout penalties where an adversary holds round 0 — so
+    # the limits bound the seeded attack cost plus host noise, not a
+    # tight perf promise.  Lower is better (unit has no "/s"), so a
+    # regression is the ratio drifting UP.
+    "byzantine_soak_overhead_x": SloSpec(warn=25.0, fail=200.0, unit="x"),
 }
 
 

@@ -5,26 +5,59 @@
 lock-step ticks; ``LoopbackClusterSim`` is the matched threaded-loopback
 baseline (the tests/harness gossip shape) used as the chain oracle and
 the bench comparison point.  ``ChaosMask`` fuses the chaos plane in as
-seeded tensor masks on the collective schedule.  See docs/CLUSTER.md.
+seeded tensor masks on the collective schedule; ``AdversaryMix`` mounts
+scripted Byzantine strategies on the same seeded schedule, and
+``InvariantMonitor`` checks the safety/liveness properties the whole
+stack promises.  See docs/CLUSTER.md and docs/ROBUSTNESS.md.
 """
 
+from .adversary import (
+    AdversaryEngine,
+    AdversaryMix,
+    CommitWithholder,
+    EquivocatingProposer,
+    RoundChangeSpammer,
+    StaleHeightReplayer,
+    STRATEGIES,
+    TreePoisoner,
+    cluster_replay_line,
+    max_adversaries,
+    parse_replay_line,
+)
 from .backend import SimBackend, sim_address, sim_block, sim_hash
-from .chaos import ChaosMask
+from .chaos import ChaosMask, WAN_PRESETS, wan_mask, wan_regions
 from .cluster import (
     ClusterResult,
     ClusterSim,
     LoopbackClusterSim,
     run_matched_pair,
 )
+from .invariants import InvariantMonitor, Violation
 
 __all__ = [
+    "AdversaryEngine",
+    "AdversaryMix",
     "ChaosMask",
     "ClusterResult",
     "ClusterSim",
+    "CommitWithholder",
+    "EquivocatingProposer",
+    "InvariantMonitor",
     "LoopbackClusterSim",
+    "RoundChangeSpammer",
+    "STRATEGIES",
     "SimBackend",
+    "StaleHeightReplayer",
+    "TreePoisoner",
+    "Violation",
+    "WAN_PRESETS",
+    "cluster_replay_line",
+    "max_adversaries",
+    "parse_replay_line",
     "run_matched_pair",
     "sim_address",
     "sim_block",
     "sim_hash",
+    "wan_mask",
+    "wan_regions",
 ]

@@ -1,0 +1,664 @@
+"""Byzantine strategy engine: scripted attackers inside the lock-step tick.
+
+Where :class:`~go_ibft_tpu.sim.chaos.ChaosMask` models *faults* (drops,
+delays, partitions — things a crashed disk or a flaky link also do), this
+module models *adversaries*: validators that pick their messages.  An
+:class:`AdversaryMix` replaces up to ``⌊(N−1)/3⌋`` of a
+:class:`~go_ibft_tpu.sim.cluster.ClusterSim`'s engines with scripted
+attackers:
+
+* **equivocator** — when it is the round-0 proposer it sends CONFLICTING
+  proposals to two disjoint halves of the cluster (selective-send via
+  the hub's targeted fan-out).  With the safety guard ON (the default)
+  it never supports either variant past the PREPREPARE, so no variant
+  can reach quorum while the mix stays within ``f`` — the honest chain
+  is provably canonical.  ``guard=False`` (requires
+  ``AdversaryMix(unsafe=True)``) additionally COMMITs each variant to
+  its half and lets fellow adversaries collude with PREPARE+COMMIT
+  support — the classic safety break the invariant harness must catch
+  when the mix exceeds tolerance (tests/test_adversary.py).
+* **commit_withholder** — a fully honest engine whose transport
+  selectively delivers: it signs every COMMIT but only half the cluster
+  (seeded per height) ever receives it.
+* **rc_spammer** — floods ROUND_CHANGE messages for rounds the cluster
+  never voted to leave, including byte-duplicate re-sends of the same
+  evidence (the satellite-4 distinct-signer-power regression surface).
+* **stale_replayer** — replays finished-height traffic and floods
+  future-height messages at the engines' bounded future buffer
+  (core/ibft.py ``future_cap_per_sender`` / ``future_cap_total``).
+* **aggtree_poisoner** — :class:`TreePoisoner` crafts negated and
+  foreign BLS partials for :mod:`go_ibft_tpu.net.aggtree`'s ingest
+  gates (used against an aggregation-tree harness; the tree is a
+  different transport plane than the lock-step hub).
+
+Every decision a strategy makes — which halves, which receivers, which
+rounds — is a pure function of ``(seed, height)`` via counter-based
+Philox, exactly like ChaosMask's ``(seed, tick)`` schedule: no stateful
+RNG to drift, so one seed replays the whole attack byte-identically and
+:func:`cluster_replay_line` emits the same CHAOS-REPLAY contract line
+the chaos plane uses (schedule digest covering BOTH the mask schedule
+and the adversary scripts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import re
+from collections import deque
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..messages import MessageType, View
+from .backend import SimBackend, sim_address, sim_block, sim_hash
+
+__all__ = [
+    "AdversaryEngine",
+    "AdversaryMix",
+    "CommitWithholder",
+    "EquivocatingProposer",
+    "RoundChangeSpammer",
+    "SelectiveSendPort",
+    "StaleHeightReplayer",
+    "STRATEGIES",
+    "TreePoisoner",
+    "cluster_replay_line",
+    "max_adversaries",
+    "parse_replay_line",
+]
+
+# (message, targets) — targets None means honest full multicast.
+Send = Tuple[object, Optional[frozenset]]
+
+
+def max_adversaries(n_nodes: int) -> int:
+    """The classic BFT bound: ``⌊(N−1)/3⌋`` scripted attackers."""
+    return (n_nodes - 1) // 3
+
+
+def _rng(*key: int) -> np.random.Generator:
+    """Counter-based Philox keyed on the given ints — the same
+    no-stateful-RNG posture as ChaosMask (replay determinism by
+    construction).  Philox keys are 4x64-bit; we fold longer keys."""
+    folded = [key[0] & 0xFFFFFFFFFFFFFFFF, 0]
+    for extra in key[1:]:
+        folded[1] = (folded[1] * 1_000_003 + extra + 1) & 0xFFFFFFFFFFFFFFFF
+    return np.random.Generator(
+        np.random.Philox(key=np.array(folded, dtype=np.uint64))
+    )
+
+
+class Strategy:
+    """One scripted attacker's decision plane (pure in ``(seed, height)``)."""
+
+    name = "strategy"
+
+    def __init__(self, mix: "AdversaryMix", index: int, addresses) -> None:
+        self.mix = mix
+        self.index = index
+        self.n_nodes = mix.n_nodes
+        self.seed = mix.seed
+        self.backend = SimBackend(index, addresses)
+
+    # -- script hooks ----------------------------------------------------
+
+    def on_height_start(self, height: int) -> List[Send]:
+        return []
+
+    def on_message(self, height: int, msg) -> List[Send]:
+        return []
+
+    def on_idle(self, height: int, burst: int) -> List[Send]:
+        return []
+
+    def script_bytes(self, height: int) -> bytes:
+        """Deterministic digest input for this strategy's per-height
+        decisions (the adversary half of the replay schedule digest)."""
+        return b"%s:%d" % (self.name.encode(), height)
+
+
+class EquivocatingProposer(Strategy):
+    """Conflicting proposals to disjoint halves when it holds round 0."""
+
+    name = "equivocator"
+
+    def __init__(self, mix, index, addresses, *, guard: bool = True) -> None:
+        super().__init__(mix, index, addresses)
+        if not guard and not mix.unsafe:
+            raise ValueError(
+                "disabling the equivocation guard needs AdversaryMix("
+                "unsafe=True) — it is the deliberate safety break the "
+                "invariant harness is tested against"
+            )
+        self.guard = guard
+        self._supported: set = set()
+
+    # Halves are keyed on (seed, height) ONLY — every colluding adversary
+    # derives the same split without communicating.
+    def halves(self, height: int) -> Tuple[frozenset, frozenset]:
+        perm = _rng(self.seed, 0xE9, height).permutation(self.n_nodes)
+        cut = self.n_nodes // 2
+        advs = set(self.mix.indices)
+        half_a = frozenset(int(i) for i in perm[:cut]) | advs
+        half_b = frozenset(int(i) for i in perm[cut:]) | advs
+        return half_a, half_b
+
+    @staticmethod
+    def variants(height: int) -> Tuple[bytes, bytes]:
+        # Both pass SimBackend.is_valid_proposal (the "sim-block-"
+        # prefix) — a strategic proposer ships VALID conflicting blocks,
+        # not garbage the validity gate would reject for free.
+        base = sim_block(height)
+        return base + b"/equiv-a", base + b"/equiv-b"
+
+    def on_height_start(self, height: int) -> List[Send]:
+        if (height % self.n_nodes) != self.index:
+            return []  # not the round-0 proposer — lie in wait
+        raw_a, raw_b = self.variants(height)
+        half_a, half_b = self.halves(height)
+        view = View(height=height, round=0)
+        sends: List[Send] = [
+            (
+                self.backend.build_preprepare_message(raw_a, None, view),
+                half_a,
+            ),
+            (
+                self.backend.build_preprepare_message(raw_b, None, view),
+                half_b,
+            ),
+        ]
+        if not self.guard:
+            # Unsafe mode: the proposer also COMMITs each variant to its
+            # half (it must NOT send PREPARE — a proposer among the
+            # prepare signers voids the quorum, validator_manager.py).
+            sends.append(
+                (
+                    self.backend.build_commit_message(sim_hash(raw_a), view),
+                    half_a,
+                )
+            )
+            sends.append(
+                (
+                    self.backend.build_commit_message(sim_hash(raw_b), view),
+                    half_b,
+                )
+            )
+        return sends
+
+    def on_message(self, height: int, msg) -> List[Send]:
+        """Collusion (unsafe mode only): support a fellow adversary's
+        equivocating proposal with PREPARE+COMMIT into its half."""
+        if self.guard:
+            return []
+        if msg.type != MessageType.PREPREPARE or msg.view is None:
+            return []
+        if msg.view.height != height or msg.view.round != 0:
+            return []
+        if msg.sender == self.backend.address:
+            return []
+        if msg.sender not in self.mix.addresses_of_adversaries:
+            return []
+        raw = msg.preprepare_data.proposal.raw_proposal
+        raw_a, raw_b = self.variants(height)
+        if raw not in (raw_a, raw_b):
+            return []
+        marker = (height, raw)
+        if marker in self._supported:
+            return []
+        self._supported.add(marker)
+        half_a, half_b = self.halves(height)
+        targets = half_a if raw == raw_a else half_b
+        view = View(height=height, round=0)
+        phash = sim_hash(raw)
+        return [
+            (self.backend.build_prepare_message(phash, view), targets),
+            (self.backend.build_commit_message(phash, view), targets),
+        ]
+
+    def script_bytes(self, height: int) -> bytes:
+        half_a, half_b = self.halves(height)
+        return b"%s:%d:%d:%r:%r:%d" % (
+            self.name.encode(),
+            self.index,
+            height,
+            sorted(half_a),
+            sorted(half_b),
+            int(self.guard),
+        )
+
+
+class CommitWithholder(Strategy):
+    """Honest engine, Byzantine delivery: every COMMIT it signs reaches
+    only a seeded half of the cluster (:class:`SelectiveSendPort`)."""
+
+    name = "commit_withholder"
+
+    def commit_targets(self, height: int) -> frozenset:
+        perm = _rng(self.seed, 0xC0, height, self.index).permutation(
+            self.n_nodes
+        )
+        cut = self.n_nodes // 2
+        return frozenset(int(i) for i in perm[:cut]) | {self.index}
+
+    def script_bytes(self, height: int) -> bytes:
+        return b"%s:%d:%d:%r" % (
+            self.name.encode(),
+            self.index,
+            height,
+            sorted(self.commit_targets(height)),
+        )
+
+
+class RoundChangeSpammer(Strategy):
+    """ROUND_CHANGE floods for rounds nobody voted to leave, with
+    byte-duplicate re-sends of the same evidence (satellite 4: quorum
+    power must stay distinct-signer no matter how often one signer
+    repeats itself)."""
+
+    name = "rc_spammer"
+
+    def __init__(
+        self, mix, index, addresses, *, max_round: int = 5,
+        dups: int = 2, bursts: int = 3,
+    ) -> None:
+        super().__init__(mix, index, addresses)
+        self.max_round = max_round
+        self.dups = dups
+        self.bursts = bursts
+
+    def _spam(self, height: int) -> List[Send]:
+        sends: List[Send] = []
+        for round_ in range(1, self.max_round + 1):
+            view = View(height=height, round=round_)
+            for _ in range(self.dups):
+                sends.append(
+                    (
+                        self.backend.build_round_change_message(
+                            None, None, view
+                        ),
+                        None,
+                    )
+                )
+        return sends
+
+    def on_height_start(self, height: int) -> List[Send]:
+        return self._spam(height)
+
+    def on_idle(self, height: int, burst: int) -> List[Send]:
+        return self._spam(height) if burst < self.bursts else []
+
+    def script_bytes(self, height: int) -> bytes:
+        return b"%s:%d:%d:%d:%d:%d" % (
+            self.name.encode(), self.index, height,
+            self.max_round, self.dups, self.bursts,
+        )
+
+
+class StaleHeightReplayer(Strategy):
+    """Replays finished heights and floods future ones — the bounded
+    future-buffer attack surface (core/ibft.py caps per sender/total)."""
+
+    name = "stale_replayer"
+
+    def __init__(
+        self, mix, index, addresses, *, stale_depth: int = 2,
+        future_span: int = 6, rounds: int = 2, bursts: int = 3,
+    ) -> None:
+        super().__init__(mix, index, addresses)
+        self.stale_depth = stale_depth
+        self.future_span = future_span
+        self.rounds = rounds
+        self.bursts = bursts
+
+    def _flood(self, height: int) -> List[Send]:
+        sends: List[Send] = []
+        heights = [
+            h for h in range(height - self.stale_depth, height)
+            if h >= 0
+        ] + list(range(height + 1, height + 1 + self.future_span))
+        for h in heights:
+            phash = sim_hash(sim_block(h))
+            for round_ in range(self.rounds):
+                view = View(height=h, round=round_)
+                sends.append(
+                    (self.backend.build_prepare_message(phash, view), None)
+                )
+                sends.append(
+                    (self.backend.build_commit_message(phash, view), None)
+                )
+                sends.append(
+                    (
+                        self.backend.build_round_change_message(
+                            None, None, view
+                        ),
+                        None,
+                    )
+                )
+        return sends
+
+    def on_height_start(self, height: int) -> List[Send]:
+        return self._flood(height)
+
+    def on_idle(self, height: int, burst: int) -> List[Send]:
+        return self._flood(height) if burst < self.bursts else []
+
+    def script_bytes(self, height: int) -> bytes:
+        return b"%s:%d:%d:%d:%d:%d" % (
+            self.name.encode(), self.index, height,
+            self.stale_depth, self.future_span, self.rounds,
+        )
+
+
+STRATEGIES = {
+    cls.name: cls
+    for cls in (
+        EquivocatingProposer,
+        CommitWithholder,
+        RoundChangeSpammer,
+        StaleHeightReplayer,
+    )
+}
+
+
+class SelectiveSendPort:
+    """Transport wrapper for the withholder: honest multicast for every
+    phase EXCEPT the COMMIT, which only the seeded half receives."""
+
+    def __init__(self, port, strategy: CommitWithholder) -> None:
+        self._port = port
+        self._strategy = strategy
+
+    def multicast(self, message) -> None:
+        if message.type == MessageType.COMMIT and message.view is not None:
+            self._port.multicast_to(
+                message, self._strategy.commit_targets(message.view.height)
+            )
+        else:
+            self._port.multicast(message)
+
+
+class AdversaryEngine:
+    """Drives one scripted strategy on the ClusterSim height barrier.
+
+    Mirrors the IBFT engine's driver surface (``run_sequence`` coroutine
+    + a batched deliver sink) so :class:`ClusterSim` can mount it on a
+    hub port without special cases.  It finalizes nothing — its sim
+    backend's chain stays empty, which is why adversary indices are
+    excluded from the honest participant set.
+    """
+
+    # How many cooperative yields between idle bursts: enough that a
+    # burst lands roughly once per few ticks without busy-spinning.
+    _IDLE_EVERY = 64
+
+    def __init__(self, strategy: Strategy, port) -> None:
+        self.strategy = strategy
+        self.backend = strategy.backend
+        self._port = port
+        self._inbox: deque = deque()
+
+    def deliver(self, batch) -> None:
+        self._inbox.extend(batch)
+
+    def _send(self, sends: List[Send]) -> None:
+        for message, targets in sends:
+            if targets is None:
+                self._port.multicast(message)
+            else:
+                self._port.multicast_to(message, targets)
+
+    async def run_sequence(self, height: int) -> None:
+        self._inbox.clear()
+        self._send(self.strategy.on_height_start(height))
+        burst = 0
+        spins = 0
+        while True:  # cancelled by the driver at height end
+            while self._inbox:
+                msg = self._inbox.popleft()
+                self._send(self.strategy.on_message(height, msg))
+            spins += 1
+            if spins % self._IDLE_EVERY == 0:
+                self._send(self.strategy.on_idle(height, burst))
+                burst += 1
+            await asyncio.sleep(0)
+
+
+class AdversaryMix:
+    """Which nodes attack, and how.
+
+    ``assignment`` maps node index -> strategy name (see
+    :data:`STRATEGIES`).  The classic tolerance bound ``⌊(N−1)/3⌋`` is
+    enforced unless ``unsafe=True`` — exceeding it (or disabling the
+    equivocation guard) is how the harness's own failure detection is
+    tested, never a configuration a soak should pass.
+    """
+
+    def __init__(
+        self,
+        n_nodes: int,
+        seed: int,
+        assignment: Mapping[int, str],
+        *,
+        unsafe: bool = False,
+        params: Optional[Dict[int, dict]] = None,
+    ) -> None:
+        self.n_nodes = int(n_nodes)
+        self.seed = int(seed)
+        self.unsafe = bool(unsafe)
+        self.assignment = {int(i): str(s) for i, s in assignment.items()}
+        self.params = {int(i): dict(p) for i, p in (params or {}).items()}
+        for i, name in self.assignment.items():
+            if not 0 <= i < n_nodes:
+                raise ValueError(f"adversary index {i} out of range")
+            if name not in STRATEGIES:
+                raise ValueError(f"unknown strategy {name!r}")
+        cap = max_adversaries(n_nodes)
+        if len(self.assignment) > cap and not unsafe:
+            raise ValueError(
+                f"{len(self.assignment)} adversaries exceeds the "
+                f"f=(N-1)//3={cap} tolerance bound at N={n_nodes} "
+                "(pass unsafe=True only to test the harness itself)"
+            )
+        self.indices = tuple(sorted(self.assignment))
+        self.addresses_of_adversaries = frozenset(
+            sim_address(i) for i in self.indices
+        )
+        self._strategies: Dict[int, Strategy] = {}
+
+    @classmethod
+    def seeded(
+        cls,
+        n_nodes: int,
+        seed: int,
+        *,
+        power: float = 0.3,
+        strategies: Sequence[str] = (
+            "equivocator",
+            "commit_withholder",
+            "rc_spammer",
+            "stale_replayer",
+        ),
+    ) -> "AdversaryMix":
+        """The bench-config mix: ``power`` of the committee turns
+        Byzantine (capped at the tolerance bound), indices drawn and
+        strategies dealt round-robin from the seed alone."""
+        k = min(int(round(n_nodes * power)), max_adversaries(n_nodes))
+        picks = _rng(seed, 0xAD).choice(n_nodes, size=k, replace=False)
+        indices = sorted(int(i) for i in picks)
+        assignment = {
+            i: strategies[j % len(strategies)]
+            for j, i in enumerate(indices)
+        }
+        return cls(n_nodes, seed, assignment)
+
+    # -- construction ----------------------------------------------------
+
+    def build(self, index: int, addresses) -> Strategy:
+        """Instantiate (and memoize) the strategy mounted at ``index``."""
+        strategy = self._strategies.get(index)
+        if strategy is None:
+            cls_ = STRATEGIES[self.assignment[index]]
+            strategy = cls_(
+                self, index, addresses, **self.params.get(index, {})
+            )
+            self._strategies[index] = strategy
+        return strategy
+
+    def honest(self) -> List[int]:
+        return [i for i in range(self.n_nodes) if i not in self.assignment]
+
+    # -- replay ----------------------------------------------------------
+
+    def config(self) -> dict:
+        return {
+            "n_nodes": self.n_nodes,
+            "seed": self.seed,
+            "unsafe": self.unsafe,
+            "adversaries": {
+                str(i): self.assignment[i] for i in self.indices
+            },
+        }
+
+    def schedule_digest(self, heights: int) -> str:
+        """Digest over every adversary's per-height script decisions —
+        the strategy half of the combined CHAOS-REPLAY digest."""
+        addresses = [sim_address(i) for i in range(self.n_nodes)]
+        h = hashlib.sha256()
+        for index in self.indices:
+            strategy = self.build(index, addresses)
+            for height in range(heights):
+                h.update(strategy.script_bytes(height))
+        return h.hexdigest()[:16]
+
+
+def cluster_replay_line(
+    chaos,
+    mix: Optional[AdversaryMix],
+    ticks: int,
+    heights: int,
+    *,
+    max_msgs: Optional[int] = None,
+    max_bytes: Optional[int] = None,
+    round_timeout: Optional[float] = None,
+) -> str:
+    """The lock-step cluster's CHAOS-REPLAY byte-identity line.
+
+    One line carries everything a re-run needs: the chaos mask config,
+    the adversary assignment, the tick/height horizon the combined
+    schedule digest was computed over (so a replay recomputes the digest
+    over the SAME horizon regardless of how many ticks its own run
+    takes), and — when given — the transport shape.  The shape matters:
+    an undersized ``max_bytes`` silently drops PC-bearing round-change
+    messages (hub stat ``dropped_oversize``) and turns a healed
+    partition into a permanent wedge, so a replay at different slot
+    sizes is a different scenario.  Parsed back by
+    :func:`parse_replay_line` / scripts/chaos_replay.py.
+    """
+    seed = chaos.seed if chaos is not None else (
+        mix.seed if mix is not None else 0
+    )
+    mask_digest = (
+        chaos.schedule_digest(ticks) if chaos is not None else "no-chaos"
+    )
+    adv_digest = (
+        mix.schedule_digest(heights) if mix is not None else "no-adversary"
+    )
+    digest = hashlib.sha256(
+        f"{mask_digest}+{adv_digest}".encode()
+    ).hexdigest()[:16]
+    cfg = {
+        "seed": seed,
+        "ticks": int(ticks),
+        "heights": int(heights),
+        "chaos": chaos.config() if chaos is not None else None,
+        "adversary": mix.config() if mix is not None else None,
+    }
+    cluster = {
+        k: v
+        for k, v in (
+            ("max_msgs", max_msgs),
+            ("max_bytes", max_bytes),
+            ("round_timeout", round_timeout),
+        )
+        if v is not None
+    }
+    if cluster:
+        cfg["cluster"] = cluster
+    blob = json.dumps(cfg, sort_keys=True, separators=(",", ":"))
+    return f"CHAOS-REPLAY seed={seed} schedule={digest} config={blob}"
+
+
+_REPLAY_RE = re.compile(
+    r"CHAOS-REPLAY seed=(\d+) schedule=([0-9a-f-]+) config=(\{.*\})\s*$"
+)
+
+
+def parse_replay_line(line: str) -> dict:
+    """``CHAOS-REPLAY seed=N schedule=D config={...}`` -> parsed dict
+    (raises ValueError on anything else)."""
+    m = _REPLAY_RE.search(line.strip())
+    if m is None:
+        raise ValueError("not a CHAOS-REPLAY line")
+    return {
+        "seed": int(m.group(1)),
+        "schedule": m.group(2),
+        "config": json.loads(m.group(3)),
+    }
+
+
+class TreePoisoner:
+    """Negated / foreign BLS partials for the aggregation tree's ingest
+    gates (:mod:`go_ibft_tpu.net.aggtree`).
+
+    The tree's Byzantine surface is different from the consensus hub's:
+    a poisoned PARTIAL that survives ingest cancels honest signatures
+    inside an aggregate, so the gates (decodable seal, member sender,
+    quarantine-bisect at certify time) are what this strategy probes.
+    Imports the BLS backend lazily — sim-crypto cluster runs never pay
+    for it.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = int(seed)
+
+    @staticmethod
+    def negated_commit(bls_key, sender: bytes, proposal_hash: bytes,
+                       height: int = 1):
+        """A member's COMMIT whose seal is the NEGATION of its honest
+        signature: structurally valid, passes every ingest gate, and
+        cancels the honest partial inside any aggregate it joins — only
+        the certify-time quarantine bisect can evict it."""
+        from ..crypto import bls as hbls
+        from ..messages.wire import CommitMessage, IbftMessage
+        from ..verify.bls import encode_seal
+
+        neg = hbls.g2_neg(bls_key.sign(proposal_hash))
+        return IbftMessage(
+            view=View(height=height, round=0),
+            sender=sender,
+            type=MessageType.COMMIT,
+            commit_data=CommitMessage(
+                proposal_hash=proposal_hash,
+                committed_seal=encode_seal(neg),
+            ),
+        )
+
+    @staticmethod
+    def foreign_commit(bls_key, proposal_hash: bytes, height: int = 1):
+        """A syntactically perfect COMMIT from an address that is NOT a
+        committee member at ``height`` — must die at the membership
+        ingest gate, never reach the pump."""
+        from ..messages.wire import CommitMessage, IbftMessage
+        from ..verify.bls import encode_seal
+
+        return IbftMessage(
+            view=View(height=height, round=0),
+            sender=b"\xee" * 20,
+            type=MessageType.COMMIT,
+            commit_data=CommitMessage(
+                proposal_hash=proposal_hash,
+                committed_seal=encode_seal(bls_key.sign(proposal_hash)),
+            ),
+        )

@@ -15,10 +15,20 @@ Fault surface:
   ``drop_rate``.  Restricting loss to a named minority keeps the
   connected majority's liveness provable: a dropped PREPREPARE has no
   retransmit, so uniform loss would eventually wedge arbitrary nodes.
-* **partition** — one ``(start_tick, end_tick, groups)`` epoch; edges
-  crossing group boundaries drop entirely while it lasts.
+* **partition** — ``(start_tick, end_tick, groups)`` epochs (one via
+  ``partition=``, any number via ``partitions=[...]``); edges crossing
+  group boundaries drop entirely while an epoch lasts.  The largest
+  epoch end is :attr:`ChaosMask.heal_tick` — the GST of the classic
+  partial-synchrony model, which the invariant harness
+  (:mod:`go_ibft_tpu.sim.invariants`) uses to anchor its bounded-rounds
+  liveness check.
 * **delay** — edges into lossy receivers defer up to ``delay_max`` whole
   ticks (the hub re-delivers when due).
+* **WAN geography** — ``regions`` (node groups) plus a ``region_delay``
+  matrix give every edge a deterministic base delay in ticks by
+  region pair, with ``jitter`` extra seeded ticks on top: the
+  multi-region topology presets (:data:`WAN_PRESETS` /
+  :func:`wan_mask`) the Byzantine soak runs over.
 
 Self-edges are never cut: a node always hears its own multicast, as in
 every other transport here.
@@ -43,6 +53,12 @@ class ChaosMask:
         lossy: Sequence[int] = (),
         delay_max: int = 0,
         partition: Optional[Tuple[int, int, Sequence[Sequence[int]]]] = None,
+        partitions: Optional[
+            Sequence[Tuple[int, int, Sequence[Sequence[int]]]]
+        ] = None,
+        regions: Optional[Sequence[Sequence[int]]] = None,
+        region_delay: Optional[Sequence[Sequence[int]]] = None,
+        jitter: int = 0,
     ) -> None:
         self.n_nodes = n_nodes
         self.seed = int(seed)
@@ -50,17 +66,50 @@ class ChaosMask:
         self.lossy = np.asarray(sorted(set(lossy)), dtype=np.int64)
         self.delay_max = int(delay_max)
         self.partition = partition
+        epochs = list(partitions or ())
         if partition is not None:
-            start, end, groups = partition
+            epochs.insert(0, partition)
+        self.partitions: list = []
+        self._epoch_masks: list = []
+        for start, end, groups in epochs:
+            norm = [sorted(int(m) for m in g) for g in groups]
+            self.partitions.append((int(start), int(end), norm))
             gid = np.zeros(n_nodes, dtype=np.int64)
-            for g, members in enumerate(groups):
+            for g, members in enumerate(norm):
                 for m in members:
                     gid[m] = g
-            self._same_group = gid[:, None] == gid[None, :]
-            self._epoch = (int(start), int(end))
+            self._epoch_masks.append(gid[:, None] == gid[None, :])
+        # Back-compat aliases for the single-epoch fields.
+        self._same_group = self._epoch_masks[0] if self._epoch_masks else None
+        self._epoch = (
+            (self.partitions[0][0], self.partitions[0][1])
+            if self.partitions
+            else None
+        )
+        # WAN geography: every edge carries a deterministic base delay by
+        # region pair; ``jitter`` adds seeded per-(tick, edge) spread.
+        self.jitter = int(jitter)
+        if regions is not None:
+            self.regions = [sorted(int(m) for m in g) for g in regions]
+            if region_delay is None:
+                raise ValueError("regions without region_delay")
+            matrix = np.asarray(region_delay, dtype=np.int64)
+            rid = np.zeros(n_nodes, dtype=np.int64)
+            for r, members in enumerate(self.regions):
+                for m in members:
+                    rid[m] = r
+            self.region_delay = matrix
+            self._base_delay = matrix[rid[:, None], rid[None, :]]
         else:
-            self._same_group = None
-            self._epoch = None
+            self.regions = None
+            self.region_delay = None
+            self._base_delay = None
+
+    @property
+    def heal_tick(self) -> int:
+        """First tick with every partition epoch over — the GST anchor
+        for bounded-rounds liveness (0 when no partitions)."""
+        return max((end for _s, end, _g in self.partitions), default=0)
 
     def _rng(self, tick: int) -> np.random.Generator:
         key = np.array([self.seed, tick], dtype=np.uint64)
@@ -72,6 +121,7 @@ class ChaosMask:
         n = self.n_nodes
         allow = np.ones((n, n), dtype=bool)
         delay = np.zeros((n, n), dtype=np.int64)
+        rng = None
         if self.lossy.size and (self.drop_rate > 0 or self.delay_max > 0):
             rng = self._rng(tick)
             if self.drop_rate > 0:
@@ -81,10 +131,19 @@ class ChaosMask:
                 delay[:, self.lossy] = rng.integers(
                     0, self.delay_max + 1, size=(n, self.lossy.size)
                 )
-        if self._epoch is not None:
-            start, end = self._epoch
+        if self._base_delay is not None:
+            delay = delay + self._base_delay
+            if self.jitter > 0:
+                if rng is None:
+                    rng = self._rng(tick)
+                delay = delay + rng.integers(
+                    0, self.jitter + 1, size=(n, n)
+                )
+        for (start, end, _groups), same in zip(
+            self.partitions, self._epoch_masks
+        ):
             if start <= tick < end:
-                allow &= self._same_group
+                allow &= same
         np.fill_diagonal(allow, True)
         np.fill_diagonal(delay, 0)
         return allow, delay
@@ -101,12 +160,43 @@ class ChaosMask:
                 None
                 if self.partition is None
                 else [
-                    self._epoch[0],
-                    self._epoch[1],
-                    [sorted(int(m) for m in g) for g in self.partition[2]],
+                    self.partitions[0][0],
+                    self.partitions[0][1],
+                    self.partitions[0][2],
                 ]
             ),
+            "partitions": [[s, e, g] for s, e, g in self.partitions],
+            "regions": self.regions,
+            "region_delay": (
+                None
+                if self.region_delay is None
+                else self.region_delay.tolist()
+            ),
+            "jitter": self.jitter,
         }
+
+    @classmethod
+    def from_config(cls, config: dict, seed: Optional[int] = None) -> "ChaosMask":
+        """Rebuild a mask from its :meth:`config` dict (the CHAOS-REPLAY
+        round trip — scripts/chaos_replay.py's cluster mode)."""
+        return cls(
+            int(config["n_nodes"]),
+            seed=int(config["seed"] if seed is None else seed),
+            drop_rate=float(config.get("drop_rate", 0.0)),
+            lossy=config.get("lossy") or (),
+            delay_max=int(config.get("delay_max", 0)),
+            partitions=[
+                (s, e, g) for s, e, g in (config.get("partitions") or ())
+            ]
+            or (
+                [tuple(config["partition"])]
+                if config.get("partition")
+                else None
+            ),
+            regions=config.get("regions"),
+            region_delay=config.get("region_delay"),
+            jitter=int(config.get("jitter", 0)),
+        )
 
     def schedule_digest(self, ticks: int) -> str:
         """Digest of the full mask schedule over ``[0, ticks)`` — two runs
@@ -131,3 +221,93 @@ class ChaosMask:
             f"CHAOS-REPLAY seed={self.seed} "
             f"schedule={self.schedule_digest(ticks)} config={cfg}"
         )
+
+
+# ---------------------------------------------------------------------------
+# WAN geo-latency topology presets (the GST model's network half)
+# ---------------------------------------------------------------------------
+
+# Delay matrices are in TICKS (the lock-step clock), loosely shaped like
+# real inter-region RTT ratios: same-region ~0, same-continent small,
+# trans-ocean the worst edge.  ``partition`` names a region to isolate
+# for ``[start, end)`` ticks — the pre-GST asynchrony window; after
+# ``end`` (== ChaosMask.heal_tick) the bounded-rounds liveness invariant
+# is armed.
+WAN_PRESETS = {
+    # Three regions (us / eu / ap), no partition: pure geography.
+    "wan3": {
+        "region_delay": [[0, 1, 3], [1, 0, 2], [3, 2, 0]],
+        "jitter": 1,
+        "partition": None,
+    },
+    # Three regions with the ap region isolated for one early epoch:
+    # the partition/heal schedule the soak's liveness invariant runs
+    # against (heal_tick == 18).
+    "wan3-partition": {
+        "region_delay": [[0, 1, 3], [1, 0, 2], [3, 2, 0]],
+        "jitter": 1,
+        "partition": (6, 18, 2),
+    },
+    # Five regions (us-east / us-west / eu / ap / sa), no partition.
+    "wan5": {
+        "region_delay": [
+            [0, 1, 2, 4, 3],
+            [1, 0, 3, 3, 4],
+            [2, 3, 0, 4, 4],
+            [4, 3, 4, 0, 5],
+            [3, 4, 4, 5, 0],
+        ],
+        "jitter": 1,
+        "partition": None,
+    },
+}
+
+
+def wan_regions(n_nodes: int, n_regions: int) -> list:
+    """Contiguous node blocks, one per region (deterministic, balanced:
+    region r holds indices ``[r*n//R, (r+1)*n//R)``)."""
+    return [
+        list(range(r * n_nodes // n_regions, (r + 1) * n_nodes // n_regions))
+        for r in range(n_regions)
+    ]
+
+
+def wan_mask(
+    preset: str,
+    n_nodes: int,
+    seed: int = 0,
+    *,
+    partition_scale: int = 1,
+    **overrides,
+) -> ChaosMask:
+    """Build a :class:`ChaosMask` from a :data:`WAN_PRESETS` entry.
+
+    ``partition_scale`` stretches the preset's partition epoch (tick
+    counts are workload-relative); ``overrides`` pass straight through to
+    the ChaosMask constructor (e.g. extra ``drop_rate``/``lossy``)."""
+    spec = WAN_PRESETS[preset]
+    regions = wan_regions(n_nodes, len(spec["region_delay"]))
+    partitions = None
+    if spec["partition"] is not None:
+        start, end, isolate = spec["partition"]
+        rest = [
+            i
+            for r, members in enumerate(regions)
+            for i in members
+            if r != isolate
+        ]
+        partitions = [
+            (
+                start * partition_scale,
+                end * partition_scale,
+                (regions[isolate], rest),
+            )
+        ]
+    kw = dict(
+        regions=regions,
+        region_delay=spec["region_delay"],
+        jitter=spec["jitter"],
+        partitions=partitions,
+    )
+    kw.update(overrides)
+    return ChaosMask(n_nodes, seed=seed, **kw)

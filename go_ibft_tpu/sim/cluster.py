@@ -29,7 +29,9 @@ from ..core import IBFT
 from ..core.transport import BatchingIngress
 from ..net.ici import IciLockstepTransport
 from ..obs import gates
+from .adversary import AdversaryEngine, CommitWithholder, SelectiveSendPort
 from .backend import SimBackend, sim_address
+from .invariants import InvariantMonitor
 
 
 class _NullLogger:
@@ -113,6 +115,9 @@ class ClusterSim:
         chaos=None,
         verifier=None,
         logger=None,
+        adversaries=None,
+        monitor: bool = False,
+        max_rounds: int = 10,
     ) -> None:
         self.n_nodes = n_nodes
         addresses = [sim_address(i) for i in range(n_nodes)]
@@ -126,15 +131,37 @@ class ClusterSim:
             chaos=chaos,
         )
         log = logger or _NullLogger()
+        self.adversaries = adversaries  # AdversaryMix or None
+        adv_indices = frozenset(adversaries.indices) if adversaries else frozenset()
+        self.honest = [i for i in range(n_nodes) if i not in adv_indices]
         self.backends: List[SimBackend] = []
-        self.engines: List[IBFT] = []
+        self.engines: list = []  # IBFT engines and AdversaryEngines
         self.ingresses: List[BatchingIngress] = []
         for i in range(n_nodes):
+            strategy = (
+                adversaries.build(i, addresses) if i in adv_indices else None
+            )
+            if strategy is not None and not isinstance(
+                strategy, CommitWithholder
+            ):
+                # Scripted attacker: no IBFT engine at all — the strategy
+                # decides every message it sends.  Its backend never
+                # finalizes, so the index is excluded from ``honest``.
+                engine = AdversaryEngine(strategy, self.hub.port(i))
+                self.hub.register(engine.deliver)
+                self.backends.append(strategy.backend)
+                self.engines.append(engine)
+                continue
+            port = self.hub.port(i)
+            if strategy is not None:
+                # Withholder: a REAL engine whose transport selectively
+                # delivers COMMITs (Byzantine at the wire, honest above).
+                port = SelectiveSendPort(port, strategy)
             backend = SimBackend(i, addresses)
             engine = IBFT(
                 log,
                 backend,
-                self.hub.port(i),
+                port,
                 batch_verifier=(
                     self.hub.tick_verifier() if verifier is not None else None
                 ),
@@ -145,6 +172,17 @@ class ClusterSim:
             self.backends.append(backend)
             self.engines.append(engine)
             self.ingresses.append(ingress)
+        # The invariant harness quantifies over honest nodes only — a
+        # withholder runs an honest engine but is still adversary-owned,
+        # so its chain carries no safety obligation.
+        self.monitor: Optional[InvariantMonitor] = None
+        if monitor or adversaries is not None:
+            self.monitor = InvariantMonitor(
+                self.backends,
+                self.honest,
+                max_rounds=max_rounds,
+                gst_tick=chaos.heal_tick if chaos is not None else 0,
+            )
 
     @staticmethod
     def _sink(ingress: BatchingIngress):
@@ -172,6 +210,8 @@ class ClusterSim:
                 ingress.flush()
             for _ in range(4):
                 await asyncio.sleep(0)
+            if self.monitor is not None:
+                self.monitor.scan(self.hub.stats()["ticks"])
             if self.hub.idle():
                 await asyncio.sleep(0.0005)
         return True
@@ -183,9 +223,14 @@ class ClusterSim:
         participants: Optional[Sequence[int]] = None,
         height_timeout: float = 30.0,
     ) -> ClusterResult:
-        required = list(
-            range(self.n_nodes) if participants is None else participants
-        )
+        if participants is None:
+            # Adversary engines never finish a height — require honest
+            # nodes only when a mix is mounted.
+            participants = (
+                self.honest if self.adversaries is not None else
+                range(self.n_nodes)
+            )
+        required = list(participants)
         t0 = time.perf_counter()
         for h in range(heights):
             tasks = [
@@ -205,6 +250,9 @@ class ClusterSim:
         for ingress in self.ingresses:
             ingress.close()
         stats = self.hub.stats()
+        if self.monitor is not None:
+            self.monitor.scan(stats["ticks"])
+            stats["invariants"] = self.monitor.summary()
         return ClusterResult(
             transport="lockstep",
             nodes=self.n_nodes,

@@ -9,11 +9,20 @@ Re-run the exact scenario with::
 
     python scripts/chaos_replay.py --seed N [--heights 5] [--nodes 6]
 
-The injector is rebuilt from the seed (and optionally a config JSON copied
-off the artifact line), the soak cluster re-runs the same deterministic
-fault schedule, and the script prints per-height progress plus the final
-schedule digest so you can confirm you replayed the right run.  Exit code
-0 = every height finalized; 1 = the failure reproduced.
+or paste the WHOLE artifact line (both replay planes share the format)::
+
+    python scripts/chaos_replay.py --line 'CHAOS-REPLAY seed=... config=...'
+
+``--seed`` rebuilds the injector-based ECDSA soak (tests/test_chaos.py's
+shape).  ``--line`` inspects the config: a lock-step cluster line (one
+whose config carries ``chaos``/``adversary`` sections, as emitted by
+``go_ibft_tpu.sim.adversary.cluster_replay_line``) rebuilds the ChaosMask
+AND the AdversaryMix, re-runs the exact ClusterSim scenario — attackers
+included — recomputes the combined schedule digest over the tick/height
+horizon recorded in the line, and reports the invariant verdict; a bare
+ChaosMask line replays the mask-only cluster.  Exit code 0 = clean
+replay; 1 = the failure reproduced (missed heights or an invariant
+violation); 2 = digest mismatch (you did not replay the same schedule).
 """
 
 import argparse
@@ -137,10 +146,101 @@ async def replay(seed: int, heights: int, n_nodes: int, config: FaultConfig) -> 
     return failed
 
 
+async def replay_cluster(
+    parsed: dict, *, round_timeout: float, height_timeout: float,
+    heights_override: int | None = None,
+) -> int:
+    """Re-run a lock-step ClusterSim scenario from a parsed CHAOS-REPLAY
+    line (ChaosMask + AdversaryMix rebuilt from the config blob)."""
+    from go_ibft_tpu.sim import (
+        AdversaryMix,
+        ChaosMask,
+        ClusterSim,
+        cluster_replay_line,
+    )
+
+    cfg = parsed["config"]
+    seed = parsed["seed"]
+    combined = "chaos" in cfg or "adversary" in cfg
+    if combined:
+        chaos_cfg = cfg.get("chaos")
+        adv_cfg = cfg.get("adversary")
+        ticks = int(cfg["ticks"])
+        heights = int(cfg["heights"])
+    else:  # bare ChaosMask.replay_line: the config IS the mask config
+        chaos_cfg, adv_cfg = cfg, None
+        ticks, heights = 0, heights_override or 3
+    if heights_override:
+        heights = heights_override
+    chaos = (
+        ChaosMask.from_config(chaos_cfg, seed=seed)
+        if chaos_cfg is not None
+        else None
+    )
+    mix = None
+    if adv_cfg is not None:
+        mix = AdversaryMix(
+            int(adv_cfg["n_nodes"]),
+            int(adv_cfg["seed"]),
+            {int(i): s for i, s in adv_cfg["adversaries"].items()},
+            unsafe=bool(adv_cfg.get("unsafe", False)),
+        )
+    n_nodes = (
+        chaos.n_nodes if chaos is not None else mix.n_nodes
+    )
+    cluster_cfg = cfg.get("cluster") or {}
+    sim = ClusterSim(
+        n_nodes,
+        max_msgs=int(cluster_cfg.get("max_msgs", 8)),
+        max_bytes=int(cluster_cfg.get("max_bytes", 1024)),
+        round_timeout=float(
+            cluster_cfg.get("round_timeout", round_timeout)
+        ),
+        chaos=chaos,
+        adversaries=mix,
+        monitor=True,
+    )
+    result = await sim.run(heights, height_timeout=height_timeout)
+    missed = result.missed_heights(sim.honest)
+    summary = sim.monitor.summary()
+    print(
+        f"replayed {n_nodes} nodes x {heights} heights "
+        f"({len(sim.honest)} honest) in {result.elapsed_s:.1f}s: "
+        f"missed_heights={missed} "
+        f"diverged={result.diverged_chains(sim.honest)}",
+        flush=True,
+    )
+    print(f"invariants: {summary}", flush=True)
+    for violation in sim.monitor.violations:
+        print(f"  {violation}", flush=True)
+    if combined:
+        replayed = cluster_replay_line(chaos, mix, ticks, heights)
+        digest = replayed.split("schedule=")[1].split(" ")[0]
+        if digest != parsed["schedule"]:
+            print(
+                f"DIGEST MISMATCH: line says {parsed['schedule']}, "
+                f"replay rebuilt {digest}",
+                flush=True,
+            )
+            return 2
+        print(f"schedule digest verified: {digest}", flush=True)
+    return 1 if (missed or not summary["ok"]) else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--seed", type=int, required=True)
-    parser.add_argument("--heights", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument(
+        "--line",
+        type=str,
+        default=None,
+        help="a full CHAOS-REPLAY artifact line; cluster-format lines "
+        "(chaos/adversary config sections) re-run the lock-step "
+        "ClusterSim scenario, adversaries included",
+    )
+    parser.add_argument("--round-timeout", type=float, default=2.0)
+    parser.add_argument("--height-timeout", type=float, default=60.0)
+    parser.add_argument("--heights", type=int, default=None)
     parser.add_argument("--nodes", type=int, default=6)
     parser.add_argument(
         "--config",
@@ -157,6 +257,27 @@ def main() -> int:
         "instants) at exit",
     )
     args = parser.parse_args()
+    if args.line is not None:
+        from go_ibft_tpu.sim import parse_replay_line
+
+        parsed = parse_replay_line(args.line)
+        cfg = parsed["config"]
+        if "chaos" in cfg or "adversary" in cfg or "n_nodes" in cfg:
+            return asyncio.run(
+                replay_cluster(
+                    parsed,
+                    round_timeout=args.round_timeout,
+                    height_timeout=args.height_timeout,
+                    heights_override=args.heights,
+                )
+            )
+        # Injector-format line: config fields ARE FaultConfig overrides.
+        args.seed = parsed["seed"]
+        args.config = json.dumps(
+            {k: v for k, v in cfg.items() if k != "seed"}
+        )
+    if args.seed is None:
+        parser.error("--seed or --line is required")
     overrides = json.loads(args.config) if args.config else {}
     config = FaultConfig(**{**DEFAULT_CONFIG, **overrides})
     if args.trace:
@@ -164,7 +285,9 @@ def main() -> int:
 
         obs_trace.enable()
     try:
-        return asyncio.run(replay(args.seed, args.heights, args.nodes, config))
+        return asyncio.run(
+            replay(args.seed, args.heights or 5, args.nodes, config)
+        )
     finally:
         if args.trace:
             from go_ibft_tpu.obs.export import write_chrome_trace

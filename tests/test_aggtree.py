@@ -454,3 +454,111 @@ def test_tree_consensus_end_to_end(committee, certifier):
         assert c.finalized_certificate is not None
         assert certifier4.verify(c.finalized_certificate)
     assert hub.certs_built == 1
+
+
+# -- validator rotation racing in-flight partials (ISSUE 18, satellite) --
+
+
+def _rotating_certifier(committee, rotate_at=3):
+    """Committee A (nodes 0..5) signs heights < ``rotate_at``; committee
+    B (nodes 2..7) signs heights >= ``rotate_at``.  Nodes 0-1 rotate
+    OUT, 6-7 rotate IN; 2-5 straddle both sets."""
+    eck, blk, _powers, _keys = committee
+
+    def members(h):
+        pairs = list(zip(eck, blk))
+        return pairs[:6] if h < rotate_at else pairs[2:]
+
+    return BLSCertifier(
+        lambda h: {e.address: 1 for e, _ in members(h)},
+        lambda h: {e.address: b.pubkey for e, b in members(h)},
+    )
+
+
+def test_rotation_races_inflight_partials_no_wedge_no_stale_cert(committee):
+    """Committee rotates at height 3 while the tree still holds a
+    sub-quorum of height-2 partials from the OUTGOING set.  Pinned: the
+    rotated-out senders cannot mint a post-rotation certificate (even
+    jointly reaching the OLD set's quorum count), the new set certifies
+    height 3 with signers drawn only from itself, and the stranded
+    height-2 partials are neither wedged nor wiped — the old set's late
+    fifth commit still completes them."""
+    eck, blk, _powers, _keys = committee
+    certifier = _rotating_certifier(committee)
+    hub, ports, _delivered, certs = _hub_with_sinks(
+        committee, certifier, auto_pump=False
+    )
+    phash2, phash3 = b"\x02" * 32, b"\x03" * 32
+    old = {e.address for e in eck[:6]}
+    new = {e.address for e in eck[2:]}
+
+    # 1) outgoing set leaves 4 height-2 partials in flight (quorum is 5)
+    for i in range(4):
+        ports[i].multicast(_commit(eck[i], blk[i], phash2, height=2))
+    hub.pump()
+    assert hub.certs_built == 0
+
+    # 2) rotation: stale senders 0-1 plus a minority of the new set send
+    # height-3 commits — 5 senders, the OLD quorum count, but only 3 are
+    # members at height 3, so no certificate may form
+    for i in (0, 1, 2, 3, 4):
+        ports[i].multicast(_commit(eck[i], blk[i], phash3, height=3))
+    hub.pump()
+    assert hub.certs_built == 0
+    # the stale-set commits fell off the aggregate path onto the
+    # reference flood path (engines judge them; the tree never will)
+    assert any(b > 0 for b in hub.stats()["flood_bytes_per_node"])
+
+    # 3) the new set completes height 3: cert builds, no stale signer
+    for i in (5, 6):
+        ports[i].multicast(_commit(eck[i], blk[i], phash3, height=3))
+    hub.pump()
+    assert hub.certs_built == 1
+    cert3 = next(c for got in certs for c in got if c.height == 3)
+    assert certifier.verify(cert3)
+    signers3 = set(cert3.signers(sorted(new)))
+    assert signers3 <= new
+    assert not signers3 & {eck[0].address, eck[1].address}
+
+    # 4) the in-flight height-2 partials survived the rotation and the
+    # post-certification GC: the old set's fifth commit completes them
+    ports[4].multicast(_commit(eck[4], blk[4], phash2, height=2))
+    hub.pump()
+    assert hub.certs_built == 2
+    cert2 = next(c for got in certs for c in got if c.height == 2)
+    assert certifier.verify(cert2)
+    assert set(cert2.signers(sorted(old))) <= old
+
+
+def test_tree_poisoner_helpers_die_at_the_right_gate(committee, certifier):
+    """The sim's TreePoisoner probes both tree gates: a foreign commit
+    must die at the MEMBERSHIP ingest gate (flood path, never a slot);
+    a member's negated seal passes ingest but is evicted by the
+    certify-time quarantine bisect, and the honest quorum still
+    certifies."""
+    from go_ibft_tpu.sim import TreePoisoner
+
+    eck, blk, _powers, _keys = committee
+    hub, ports, _delivered, certs = _hub_with_sinks(
+        committee, certifier, auto_pump=False
+    )
+    phash = b"\x0b" * 32
+    # foreign signer: syntactically perfect, not a member -> flood path
+    ports[0].multicast(TreePoisoner.foreign_commit(blk[0], phash))
+    hub.pump()
+    assert hub.certs_built == 0
+    assert any(b > 0 for b in hub.stats()["flood_bytes_per_node"])
+    # member with a NEGATED seal: cancels its honest sibling inside the
+    # aggregate; quarantine bisect must evict it, honest cert builds
+    ports[1].multicast(
+        TreePoisoner.negated_commit(blk[1], eck[1].address, phash)
+    )
+    for i in range(2, 8):
+        ports[i].multicast(_commit(eck[i], blk[i], phash, height=1))
+    hub.pump()
+    assert hub.certs_built == 1
+    cert = next(c for got in certs for c in got if c.height == 1)
+    assert certifier.verify(cert)
+    honest = {e.address for e in eck[2:]}
+    assert set(cert.signers(sorted({e.address for e in eck}))) <= honest
+    assert hub.rejected_partials >= 1

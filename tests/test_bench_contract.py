@@ -746,3 +746,54 @@ def test_single_shared_probe_knob():
         assert "utils.probe" in src or "utils import probe" in src
         # no private probe subprocess implementations left behind
         assert "subprocess.run" not in src
+
+
+def test_byzantine_only_flag_scopes_evidence_contract():
+    """`bench.py --byzantine-only` (the make byzantine-smoke entry) runs
+    ONLY config #16 and scopes the rc=0 evidence contract to it — static
+    check on _run, like the other --*-only pins.  Like #15, config #16
+    carries a driver-schedule reserve so under the default budget it
+    skips with an honest evidence line and the scoped entry point is
+    where it measures."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "byzantine_only" in src
+    assert "config16_byzantine_soak" in src
+
+
+def test_byzantine_soak_schedule_membership_and_schema():
+    """Config #16's driver contract: it sits in BOTH schedules, owns the
+    byzantine_soak_100v metric key, gates invariants and liveness BEFORE
+    publishing timing, emits the replayable CHAOS-REPLAY artifact, and
+    routes the clean/degraded overhead ratio through obs/gates.py."""
+    import inspect
+
+    from go_ibft_tpu.obs import gates
+
+    for schedule in (bench._FALLBACK_SCHEDULE, bench._DEVICE_SCHEDULE):
+        assert any(
+            fn.__name__ == "config16_byzantine_soak" for fn, _ in schedule
+        ), "config16 missing from a driver schedule"
+    assert bench.config16_byzantine_soak.metric == "byzantine_soak_100v"
+    src = inspect.getsource(bench.config16_byzantine_soak)
+    # replay artifact + invariant/liveness gates precede the evidence line
+    for needle in (
+        "cluster_replay_line",
+        "missed_heights",
+        "summary",
+        "gate_slo_records",
+        "byzantine_soak_overhead_x",
+        "AdversaryMix.seeded",
+    ):
+        assert needle in src, f"config16 lost its {needle} step"
+    assert src.index("gate_slo_records") < src.index("_log(")
+    # the overhead ratio and the invariant counters are SLO-gated keys
+    assert "byzantine_soak_overhead_x" in gates.DEFAULT_SLO_TABLE
+    for inv in ("agreement", "validity", "bounded_rounds"):
+        spec = gates.DEFAULT_SLO_TABLE[f"invariant_{inv}"]
+        assert spec.warn == 0 and spec.fail == 0, (
+            "invariant SLOs must have zero tolerance"
+        )

@@ -221,3 +221,93 @@ async def test_byzantine_over_limit_breaks_liveness():
             assert len(node.inserted_blocks) == 1  # nothing new inserted
     finally:
         cluster.shutdown()
+
+
+# -- duplicate round-change evidence pins (ISSUE 18, satellite) ----------
+#
+# Audit conclusion: round-change voting power is distinct-signer-only at
+# every layer — the store slots one message per (view, sender), quorum
+# accounting sums over the deduplicated sender SET, and a wire RCC with a
+# repeated signer dies at has_unique_senders (core/ibft.py's RCC
+# validation).  These tests pin each layer so a refactor cannot quietly
+# let one validator's duplicated ROUND_CHANGE messages count twice.
+
+
+def _rc(sender: bytes, height: int = 1, round_: int = 1):
+    from go_ibft_tpu.messages.wire import View
+
+    return build_round_change(None, None, View(height=height, round=round_), sender)
+
+
+def test_duplicate_round_change_occupies_one_store_slot():
+    from go_ibft_tpu.messages.store import MessageStore
+    from go_ibft_tpu.messages.wire import MessageType, View
+
+    store = MessageStore()
+    dup_sender = b"\x01" * 20
+    store.add_message(_rc(dup_sender))
+    store.add_message(_rc(dup_sender))  # same (view, sender): overwrite
+    store.add_message(_rc(b"\x02" * 20))
+    got = store.get_valid_messages(
+        View(height=1, round=1), MessageType.ROUND_CHANGE, lambda _m: True
+    )
+    assert len(got) == 2
+    assert sorted(m.sender for m in got) == [b"\x01" * 20, b"\x02" * 20]
+
+
+def test_round_change_quorum_power_is_distinct_signer_only():
+    from go_ibft_tpu.core.validator_manager import (
+        ValidatorManager,
+        senders_of,
+    )
+
+    addrs = [bytes([i]) * 20 for i in range(1, 5)]
+
+    class _Backend:
+        def get_voting_powers(self, _height):
+            return {a: 1 for a in addrs}
+
+    class _Log:
+        def info(self, *a):
+            pass
+
+        debug = error = info
+
+    vm = ValidatorManager(_Backend(), _Log())
+    vm.init(1)
+    assert vm.quorum_size == 3
+    # one sender's triplicated evidence is ONE vote: 2 distinct < quorum
+    spam = [_rc(addrs[0]), _rc(addrs[0]), _rc(addrs[0]), _rc(addrs[1])]
+    assert senders_of(spam) == {addrs[0], addrs[1]}
+    assert not vm.has_quorum(m.sender for m in spam)
+    # a third DISTINCT signer tips it
+    assert vm.has_quorum(m.sender for m in spam + [_rc(addrs[2])])
+
+
+def test_wire_rcc_with_duplicate_evidence_fails_unique_senders():
+    from go_ibft_tpu.messages import has_unique_senders
+
+    a, b = b"\x0a" * 20, b"\x0b" * 20
+    assert has_unique_senders([_rc(a), _rc(b)])
+    assert not has_unique_senders([_rc(a), _rc(b), _rc(a)])
+    assert not has_unique_senders([])  # empty evidence is not a quorum
+
+
+def test_rcc_validation_calls_unique_senders_gate():
+    """Pin the call-site: core/ibft.py's RCC validation must keep the
+    has_unique_senders gate on the wire certificate's message list."""
+    import ast
+    import inspect
+
+    from go_ibft_tpu.core import ibft as ibft_mod
+
+    src = inspect.getsource(ibft_mod)
+    tree = ast.parse(src)
+    calls = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "has_unique_senders"
+    ]
+    assert calls, "RCC validation lost its has_unique_senders gate"

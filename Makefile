@@ -4,7 +4,8 @@
 	warm cluster-bench cluster-soak obs-report chain-soak mesh-bench compile-budget \
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
 	serve-bench timeline-smoke slo-gates multipair-bench cost-report \
-	boot-bench boot-check byzantine-smoke byzantine-soak
+	boot-bench boot-check byzantine-smoke byzantine-soak fleet-bench \
+	fleet-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -198,6 +199,28 @@ byzantine-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	GO_IBFT_BENCH_BUDGET_S=600 \
 	python bench.py --byzantine-only
+
+# Multi-process fleet bench (config #17): 4 REAL `python -m
+# go_ibft_tpu.node` validator subprocesses gossiping IBFT over TCP
+# while a concurrent client fleet + seeded churn/slowloris adversaries
+# flood their proof APIs.  QoS-gated before timing (no missed height,
+# no cross-process chain divergence, every slowloris socket cut);
+# metric = proofs/s.  GO_IBFT_FLEET_NODES / _HEIGHTS / _CONNS / _CHURN
+# / _SLOW / _SEED / _THINK_S scale it.
+fleet-bench:
+	JAX_PLATFORMS=cpu \
+	GO_IBFT_BENCH_BUDGET_S=600 \
+	python bench.py --fleet-only
+
+# Fleet smoke (fast-tier CI, every push): 2 validator processes over
+# real sockets under a small proof flood, SLO-gated (scripts/fleet.py
+# exits nonzero on any gate breach or missing drain report).
+fleet-smoke:
+	rm -f slo.jsonl
+	JAX_PLATFORMS=cpu GO_IBFT_SLO_PATH=slo.jsonl \
+	python scripts/fleet.py --nodes 2 --heights 2 --connections 16 \
+		--churn-clients 1 --slowloris-clients 1 --think-s 0.2 \
+		--min-flood-s 1.5
 
 # Slow-tier byzantine soak: 3 seeds x the full strategy matrix at 12
 # validators over WAN chaos, every invariant checked every tick

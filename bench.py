@@ -3263,6 +3263,130 @@ def config16_byzantine_soak() -> None:
     )
 
 
+def config17_fleet() -> None:
+    """Multi-process fleet (config #17): N REAL ``python -m
+    go_ibft_tpu.node`` validator subprocesses gossiping IBFT over TCP
+    sockets while a concurrent client fleet (plus seeded churn +
+    slowloris adversaries) floods their proof APIs — the deployable-node
+    composition measured end to end (sim/fleet.py, ISSUE 19).
+
+    Gate order mirrors #15/#16: the QoS contract gates BEFORE any timing
+    is published — every node must finalize every height under the flood
+    (missed_heights == 0), every node must serve the SAME chain over the
+    untrusted-client wire (diverged_chains == 0), and the header timeout
+    must have cut every slowloris socket.  The CHAOS-REPLAY line printed
+    above the evidence makes the client plan replayable via
+    scripts/chaos_replay.py --line.  Metric = proofs/s sustained by the
+    client fleet; proof p99 and cross-process consensus finalize p99
+    ride along as SLO records for obs/gates.py.
+    """
+    import tempfile
+
+    from go_ibft_tpu.obs import gates
+    from go_ibft_tpu.sim.fleet import FleetSpec, run_fleet
+
+    nodes = int(os.environ.get("GO_IBFT_FLEET_NODES", "4"))
+    heights = int(os.environ.get("GO_IBFT_FLEET_HEIGHTS", "3"))
+    conns = int(os.environ.get("GO_IBFT_FLEET_CONNS", "64"))
+    churn = int(os.environ.get("GO_IBFT_FLEET_CHURN", "2"))
+    slow = int(os.environ.get("GO_IBFT_FLEET_SLOW", "2"))
+    seed = int(os.environ.get("GO_IBFT_FLEET_SEED", "7"))
+    think_s = float(os.environ.get("GO_IBFT_FLEET_THINK_S", "0.5"))
+
+    spec = FleetSpec(
+        nodes=nodes,
+        heights=heights,
+        connections=conns,
+        churn_clients=churn,
+        slowloris_clients=slow,
+        seed=seed,
+        think_s=think_s,
+    )
+    with tempfile.TemporaryDirectory() as run_dir:
+        result = run_fleet(spec, run_dir)
+    print(result.replay_line, flush=True)
+
+    # QoS gate BEFORE timing: the flood and the adversaries must not have
+    # cost consensus a single height on any process.
+    slow_stats = result.slowloris
+    uncut = max(0, slow_stats["opened"] - slow_stats["cut_by_server"])
+    records = [
+        gates.slo_record(
+            "missed_heights",
+            result.missed_heights,
+            context={"nodes": nodes, "heights": heights, "config": 17},
+        ),
+        gates.slo_record(
+            "fleet_diverged_chains",
+            result.diverged_chains,
+            fail=0.0,
+            context={"heads": result.heads},
+        ),
+        gates.slo_record(
+            "fleet_slowloris_uncut", uncut, fail=0.0, context=slow_stats
+        ),
+    ]
+    if result.proof_p99_ms is not None:
+        records.append(
+            gates.slo_record(
+                "fleet_proof_p99_ms",
+                result.proof_p99_ms,
+                fail=30_000.0,
+                context={"proofs": result.proofs_total},
+            )
+        )
+    if result.finalize_p99_ms is not None:
+        records.append(
+            gates.slo_record(
+                "finalize_p99_ms", result.finalize_p99_ms, fail=60_000.0
+            )
+        )
+    graded = gates.gate_slo_records(records)
+    slo_failures = [g for g in graded if g.status == "fail"]
+    assert not slo_failures, (
+        f"SLO gate failures: {slo_failures} — replay with: "
+        f"{result.replay_line}"
+    )
+    assert result.proofs_total > 0 and result.proof_p99_ms is not None, (
+        "client fleet recorded no served proofs"
+    )
+    assert result.verified_proofs == nodes, (
+        f"spot-verified {result.verified_proofs}/{nodes} full-range proofs"
+    )
+    assert sum(1 for r in result.reports if r) == nodes, (
+        "a node exited without a drain report"
+    )
+    assert result.timeline_heights > 0, (
+        "cross-process timeline reconstructed 0 heights"
+    )
+
+    _log(
+        {
+            "metric": config17_fleet.metric,
+            "value": round(result.proofs_s, 2),
+            "unit": "proofs/s",
+            "vs_baseline": None,
+            "variant": "cpu-fallback" if _FALLBACK else "device",
+            "nodes": nodes,
+            "heights": heights,
+            "connections": conns,
+            "peak_connections": result.peak_connections,
+            "proofs_total": result.proofs_total,
+            "proof_p50_ms": result.proof_p50_ms,
+            "proof_p99_ms": result.proof_p99_ms,
+            "finalize_p99_ms": result.finalize_p99_ms,
+            "missed_heights": result.missed_heights,
+            "diverged_chains": result.diverged_chains,
+            "verified_proofs": result.verified_proofs,
+            "timeline_heights": result.timeline_heights,
+            "churn": result.churn,
+            "slowloris": slow_stats,
+            "elapsed_s": round(result.elapsed_s, 2),
+            "replay": result.replay_line,
+        }
+    )
+
+
 def _guarded(config_fn, failures: list, reserve_s: float = 0.0) -> None:
     """Secondary configs must not take down the headline: report the
     failure as a JSON line and keep going.  The differential smoke and the
@@ -3324,6 +3448,7 @@ config13_multipair.metric = "batched_multipairing_1000c"
 config14_boot_warm_start.metric = "boot_warm_start"
 config15_cluster.metric = "cluster_lockstep_100v"
 config16_byzantine_soak.metric = "byzantine_soak_100v"
+config17_fleet.metric = "multiprocess_fleet"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -3351,6 +3476,12 @@ _FALLBACK_SCHEDULE = (
     (config11_commit_critical_path, 95.0),
     (config12_proof_serving, 65.0),
     (config13_multipair, 35.0),
+    # Config #17 launches 4 real validator subprocesses + the client
+    # fleet (~20-40 s end to end including process boots); it sits in
+    # front of the #16/#15/#14 skip ladder so a tight driver budget
+    # skips it with an honest evidence line and `make fleet-bench`
+    # (--fleet-only) measures it scoped.
+    (config17_fleet, 465.0),
     # Config #16 runs the 100-validator cluster three more times
     # (warmup + clean + degraded) with the invariant harness scanning
     # every tick: comparable cost to #15, so the same skip-with-honest-
@@ -3392,6 +3523,7 @@ _DEVICE_SCHEDULE = (
     (config11_commit_critical_path, 350.0),
     (config12_proof_serving, 330.0),
     (config13_multipair, 310.0),
+    (config17_fleet, 309.0),
     (config16_byzantine_soak, 308.0),
     (config15_cluster, 305.0),
     # Runs last before the headline: its child-process cold compile is
@@ -3532,6 +3664,17 @@ def main(argv=None) -> None:
         "the 1000-validator one-dispatch structural tick; "
         "GO_IBFT_CLUSTER_NODES / GO_IBFT_CLUSTER_HEIGHTS / "
         "GO_IBFT_CLUSTER_STRUCT_NODES scale it)",
+    )
+    parser.add_argument(
+        "--fleet-only",
+        action="store_true",
+        help="run ONLY the multi-process fleet config (#17); the rc=0 "
+        "evidence contract scopes to it (the `make fleet-bench` entry "
+        "point — real validator subprocesses over TCP under a concurrent "
+        "proof-client flood plus churn/slowloris adversaries, QoS-gated "
+        "before timing; GO_IBFT_FLEET_NODES / GO_IBFT_FLEET_HEIGHTS / "
+        "GO_IBFT_FLEET_CONNS / GO_IBFT_FLEET_CHURN / GO_IBFT_FLEET_SLOW "
+        "/ GO_IBFT_FLEET_SEED / GO_IBFT_FLEET_THINK_S scale it)",
     )
     parser.add_argument(
         "--byzantine-only",
@@ -3744,6 +3887,21 @@ def _run(args) -> None:
         failures = []
         _guarded(config15_cluster, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config15_cluster.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.fleet_only:
+        # Scoped run for `make fleet-bench`: only config #17, rc=0 iff
+        # its evidence line landed.  The config gates the QoS contract
+        # (no missed height, no chain divergence, every slowloris socket
+        # cut) before publishing proofs/s, and prints the CHAOS-REPLAY
+        # line that makes the client plan replayable.
+        failures = []
+        _guarded(config17_fleet, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config17_fleet.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

@@ -131,6 +131,10 @@ class ChainRunner:
         self.max_chain_blocks = max_chain_blocks
         self.height = 1  # next height to run
         self._restore: Optional[RestoredState] = None
+        # Readiness half of the supervisor contract (/readyz): flips in
+        # recover() — a node with a WAL is not routable before its replay
+        # completed, however long warm-start takes.
+        self._recovered = False
         self._sync_wake = asyncio.Event() if sync is not None else None
         self._running = False
         # Evidence counters (bench config #7 reads these).
@@ -263,6 +267,7 @@ class ChainRunner:
             self._append_block(block)
         self.height = state.next_height
         self._restore = None
+        self._recovered = True
         if state.lock is not None and state.lock.height >= self.height:
             self.height = state.lock.height
             self._restore = RestoredState(
@@ -631,6 +636,7 @@ class ChainRunner:
         server = TelemetryServer(
             status_fn=self.telemetry_status,
             health_fn=self.telemetry_health,
+            ready_fn=self.telemetry_ready,
             host=host,
             port=port,
         )
@@ -704,6 +710,31 @@ class ChainRunner:
             "limit_s": limit,
             "height": self.height,
             "chain_height": self.latest_height(),
+        }
+
+    def telemetry_ready(self):
+        """The /readyz verdict: (ready, payload) — may traffic be routed?
+
+        Distinct from :meth:`telemetry_health` (liveness) on purpose: a
+        warm-starting node is alive the whole time ``recover()`` replays
+        the WAL, but routing clients to it before the replay lands them
+        on a stale (or empty) chain.  Ready iff
+
+        * the WAL, when there is one, has been replayed
+          (``recover()`` completed — the supervisor contract from
+          ISSUE 19), and
+        * at least one height is finalized on the chain tail, so the
+          node can actually serve reads (a fresh genesis node becomes
+          ready the moment height 1 lands).
+        """
+        recovered = self.wal is None or self._recovered
+        first_height = self.latest_height() >= 1
+        ready = recovered and first_height
+        return ready, {
+            "ready": ready,
+            "recovered": recovered,
+            "chain_height": self.latest_height(),
+            "running": self._running,
         }
 
     def export_trace(self, path: str) -> int:

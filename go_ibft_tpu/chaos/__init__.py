@@ -13,7 +13,10 @@ wrappers applies it at the three seams the engine already exposes:
 * pipeline dispatch callables (:func:`chaotic_dispatch`);
 * chain-layer hooks — seeded kill -9 points for crash/restart suites
   (:class:`CrashRestart` raising :class:`SimulatedCrash`), recovered via
-  ``ChainRunner.recover()`` WAL replay.
+  ``ChainRunner.recover()`` WAL replay;
+* serve-plane clients — adversarial HTTP clients (connection churn +
+  slowloris) for the multi-process fleet harness
+  (:class:`ChurningClient`/:class:`SlowlorisClient`, :mod:`.clients`).
 
 Any chaos-test failure prints a ``CHAOS-REPLAY`` line with the seed and
 schedule digest (:func:`replay_on_failure`); ``scripts/chaos_replay.py``
@@ -22,6 +25,12 @@ faults exercise lives in :mod:`go_ibft_tpu.verify` (quarantine bisection +
 circuit breaker); see docs/ROBUSTNESS.md for the full fault model.
 """
 
+from .clients import (
+    ChurningClient,
+    SlowlorisClient,
+    client_schedule_digest,
+    fleet_replay_line,
+)
 from .injector import (
     FaultConfig,
     FaultInjector,
@@ -54,6 +63,10 @@ __all__ = [
     "ChaoticDeliver",
     "ChaoticTransport",
     "ChaoticVerifier",
+    "ChurningClient",
+    "SlowlorisClient",
     "chaotic_dispatch",
+    "client_schedule_digest",
     "corrupt_message",
+    "fleet_replay_line",
 ]

@@ -45,6 +45,7 @@ _FULL_METHOD = f"/{_SERVICE}/{_METHOD}"
 
 RETRY_KEY = ("go-ibft", "transport", "retries")
 SEND_FAILURE_KEY = ("go-ibft", "transport", "send_failures")
+PEER_RECONNECT_KEY = ("go-ibft", "transport", "peer_reconnects")
 
 
 def _identity(b: bytes) -> bytes:
@@ -78,6 +79,7 @@ class GrpcTransport:
         per_attempt_timeout_s: float = 2.0,
         retry_seed: Optional[int] = None,
         node: Optional[str] = None,
+        reconnect_after: int = 2,
     ) -> None:
         # Telemetry identity: the flight-recorder track inbound wire
         # events land on.  Pass the engine's node track (``node-<id>``)
@@ -105,6 +107,15 @@ class GrpcTransport:
         # sequences; unseeded production transports de-synchronize
         # naturally.
         self._jitter = random.Random(retry_seed)
+        # Peer reconnect (ISSUE 19): a gRPC channel that watched its peer
+        # restart can sit in TRANSIENT_FAILURE holding a dead subchannel
+        # while the peer is already back on the same address.  After
+        # ``reconnect_after`` consecutive exhausted send deadlines to one
+        # peer the channel is torn down and recreated, so a restarted
+        # validator rejoins the mesh within one send deadline instead of
+        # riding gRPC's internal reconnect backoff ladder.
+        self.reconnect_after = max(1, reconnect_after)
+        self._fail_streak: Dict[str, int] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -186,6 +197,7 @@ class GrpcTransport:
             self._server = None
 
     def add_peer(self, name: str, target: str) -> None:
+        self._peers[name] = target
         channel = grpc.aio.insecure_channel(target)
         self._channels[name] = channel
         self._stubs[name] = channel.unary_unary(
@@ -193,6 +205,33 @@ class GrpcTransport:
             request_serializer=_identity,
             response_deserializer=_identity,
         )
+
+    def _reconnect_peer(self, name: str) -> None:
+        """Tear down and recreate one peer's channel (see ``reconnect_after``).
+
+        The old channel closes asynchronously (its in-flight RPCs were
+        already written off by the send deadline); the fresh channel picks
+        up the SAME target, so a peer that restarted on its address gets a
+        clean TCP connect on the very next multicast.
+        """
+        target = self._peers.get(name)
+        old = self._channels.pop(name, None)
+        self._stubs.pop(name, None)
+        if old is not None:
+            try:
+                task = asyncio.get_running_loop().create_task(old.close())
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+            except RuntimeError:  # no running loop (unit tests)
+                pass
+        if target is None:
+            return
+        self.add_peer(name, target)
+        self._fail_streak[name] = 0
+        metrics.inc_counter(PEER_RECONNECT_KEY)
+        trace.instant("net.reconnect", peer=name, target=target)
+        if self._log:
+            self._log.info("grpc transport: reconnected peer", name, target)
 
     # -- Transport seam -------------------------------------------------
 
@@ -238,6 +277,7 @@ class GrpcTransport:
                         payload,
                         timeout=min(self.per_attempt_timeout_s, remaining),
                     )
+                self._fail_streak.pop(name, None)
                 return
             except asyncio.CancelledError:
                 return  # transport stopping: drop silently, never retry
@@ -261,6 +301,12 @@ class GrpcTransport:
         trace.instant("net.send_failed", peer=name, attempts=attempt)
         if self._log:
             self._log.debug("grpc multicast gave up", name, attempt)
+        # Consecutive exhausted deadlines to one peer: assume the channel
+        # went bad (peer restart), not just the link — rebuild it.
+        streak = self._fail_streak.get(name, 0) + 1
+        self._fail_streak[name] = streak
+        if streak >= self.reconnect_after and name in self._peers:
+            self._reconnect_peer(name)
 
 
 def local_cluster_addresses(n: int) -> Sequence[str]:

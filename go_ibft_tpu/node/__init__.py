@@ -1,0 +1,24 @@
+"""The deployable validator process (ISSUE 19).
+
+``python -m go_ibft_tpu.node --config node.toml`` boots one validator:
+socket-native consensus gossip (:mod:`go_ibft_tpu.net`), WAL-backed
+chain (:mod:`go_ibft_tpu.chain`), QoS-tiered verification
+(:mod:`go_ibft_tpu.sched`), the proof-API wire transport
+(:mod:`go_ibft_tpu.serve` over :mod:`.proof_api`), telemetry with the
+liveness/readiness split (:mod:`go_ibft_tpu.obs.httpd`), and graceful
+SIGTERM drain.  See docs/DEPLOYMENT.md.
+"""
+
+from .config import NodeConfig, NodeConfigError, load_config, parse_toml_subset
+from .node import ValidatorNode, build_block_fn
+from .proof_api import ProofApiServer
+
+__all__ = [
+    "NodeConfig",
+    "NodeConfigError",
+    "ProofApiServer",
+    "ValidatorNode",
+    "build_block_fn",
+    "load_config",
+    "parse_toml_subset",
+]

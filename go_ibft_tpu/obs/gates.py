@@ -539,6 +539,15 @@ DEFAULT_SLO_TABLE: Dict[str, SloSpec] = {
     # tight perf promise.  Lower is better (unit has no "/s"), so a
     # regression is the ratio drifting UP.
     "byzantine_soak_overhead_x": SloSpec(warn=25.0, fail=200.0, unit="x"),
+    # Multi-process fleet (bench config #17 / scripts/fleet.py): N real
+    # validator processes over TCP under a concurrent proof-client
+    # flood.  Chain divergence across processes and an uncut slowloris
+    # socket are zero-tolerance; the proof-latency tail is bounded
+    # loosely (1-core CI hosts serve hundreds of concurrent clients) and
+    # tightened per-run by the harness flags.
+    "fleet_diverged_chains": SloSpec(warn=0, fail=0, unit="nodes"),
+    "fleet_slowloris_uncut": SloSpec(warn=0, fail=0, unit="sockets"),
+    "fleet_proof_p99_ms": SloSpec(warn=10_000.0, fail=30_000.0, unit="ms"),
 }
 
 

@@ -11,6 +11,14 @@ a given host) and serves:
 * ``GET /healthz``  — liveness JSON from the mounted ``health_fn``;
   HTTP 200 when healthy, 503 when not (a wedged runner flips this — the
   probe a fleet orchestrator restarts on);
+* ``GET /readyz``   — readiness JSON from the mounted ``ready_fn``:
+  200 only once the node may be routed traffic (recovered + first
+  height finalized), 503 before that.  Distinct from liveness on
+  purpose — a warm-starting node is alive (do not restart it) but not
+  ready (do not send it clients yet); supervisors probe the two
+  endpoints for the two decisions.  With no ``ready_fn`` mounted the
+  endpoint reports ready (a mount that never warms has nothing to
+  gate);
 * ``GET /statusz``  — operator status JSON from ``status_fn`` (current
   height/round, breaker level, speculation hit rate, cache stats, ring
   ``dropped`` — whatever the mounting component provides), plus a
@@ -45,6 +53,7 @@ __all__ = ["TelemetryServer"]
 
 StatusFn = Callable[[], dict]
 HealthFn = Callable[[], Tuple[bool, dict]]
+ReadyFn = Callable[[], Tuple[bool, dict]]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -52,6 +61,7 @@ class _Handler(BaseHTTPRequestHandler):
     # The outer TelemetryServer injects these per server class (below).
     status_fn: Optional[StatusFn] = None
     health_fn: Optional[HealthFn] = None
+    ready_fn: Optional[ReadyFn] = None
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         path, _, query = self.path.partition("?")
@@ -66,6 +76,13 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = dict(payload)
                 payload.setdefault("ok", ok)
                 self._reply_json(200 if ok else 503, payload)
+            elif path == "/readyz":
+                ready, payload = (
+                    self.ready_fn() if self.ready_fn is not None else (True, {})
+                )
+                payload = dict(payload)
+                payload.setdefault("ready", ready)
+                self._reply_json(200 if ready else 503, payload)
             elif path == "/statusz":
                 payload = self.status_fn() if self.status_fn is not None else {}
                 payload = dict(payload)
@@ -135,6 +152,7 @@ class TelemetryServer:
         *,
         status_fn: Optional[StatusFn] = None,
         health_fn: Optional[HealthFn] = None,
+        ready_fn: Optional[ReadyFn] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
@@ -149,7 +167,8 @@ class TelemetryServer:
             "_BoundHandler",
             (_Handler,),
             {"status_fn": staticmethod(status_fn) if status_fn else None,
-             "health_fn": staticmethod(health_fn) if health_fn else None},
+             "health_fn": staticmethod(health_fn) if health_fn else None,
+             "ready_fn": staticmethod(ready_fn) if ready_fn else None},
         )
 
     def start(self) -> int:

@@ -8,7 +8,11 @@ the bench comparison point.  ``ChaosMask`` fuses the chaos plane in as
 seeded tensor masks on the collective schedule; ``AdversaryMix`` mounts
 scripted Byzantine strategies on the same seeded schedule, and
 ``InvariantMonitor`` checks the safety/liveness properties the whole
-stack promises.  See docs/CLUSTER.md and docs/ROBUSTNESS.md.
+stack promises.  :mod:`.fleet` leaves the process entirely: N REAL
+``python -m go_ibft_tpu.node`` validator subprocesses over TCP sockets
+plus a concurrent client fleet against their proof APIs
+(:func:`run_fleet`, ISSUE 19).  See docs/CLUSTER.md, docs/ROBUSTNESS.md
+and docs/DEPLOYMENT.md.
 """
 
 from .adversary import (
@@ -32,6 +36,14 @@ from .cluster import (
     LoopbackClusterSim,
     run_matched_pair,
 )
+from .fleet import (
+    ConnectionFleet,
+    FleetResult,
+    FleetSpec,
+    alloc_ports,
+    build_fleet_configs,
+    run_fleet,
+)
 from .invariants import InvariantMonitor, Violation
 
 __all__ = [
@@ -41,6 +53,9 @@ __all__ = [
     "ClusterResult",
     "ClusterSim",
     "CommitWithholder",
+    "ConnectionFleet",
+    "FleetResult",
+    "FleetSpec",
     "EquivocatingProposer",
     "InvariantMonitor",
     "LoopbackClusterSim",
@@ -51,9 +66,12 @@ __all__ = [
     "TreePoisoner",
     "Violation",
     "WAN_PRESETS",
+    "alloc_ports",
+    "build_fleet_configs",
     "cluster_replay_line",
     "max_adversaries",
     "parse_replay_line",
+    "run_fleet",
     "run_matched_pair",
     "sim_address",
     "sim_block",

@@ -20,9 +20,14 @@ whose config carries ``chaos``/``adversary`` sections, as emitted by
 AND the AdversaryMix, re-runs the exact ClusterSim scenario — attackers
 included — recomputes the combined schedule digest over the tick/height
 horizon recorded in the line, and reports the invariant verdict; a bare
-ChaosMask line replays the mask-only cluster.  Exit code 0 = clean
-replay; 1 = the failure reproduced (missed heights or an invariant
-violation); 2 = digest mismatch (you did not replay the same schedule).
+ChaosMask line replays the mask-only cluster.  A FLEET line (config
+carries a ``fleet`` section, as emitted by
+``go_ibft_tpu.chaos.fleet_replay_line``) replays the seeded adversarial
+CLIENT plan — churn + slowloris — against a fresh in-process proof API
+and re-asserts the header-timeout defense plus the schedule digest.
+Exit code 0 = clean replay; 1 = the failure reproduced (missed heights,
+an invariant violation, or an uncut slowloris socket); 2 = digest
+mismatch (you did not replay the same schedule).
 """
 
 import argparse
@@ -227,6 +232,84 @@ async def replay_cluster(
     return 1 if (missed or not summary["ok"]) else 0
 
 
+def replay_fleet(parsed: dict, *, window_s: float = 3.0) -> int:
+    """Replay a fleet CHAOS-REPLAY line: the seeded client plan against
+    a fresh in-process proof API (no chain needed — churn hits ``/head``
+    and slowloris never finishes a request), then re-verify the schedule
+    digest the way ``cluster_replay_line`` replays do."""
+    import threading
+
+    from go_ibft_tpu.chaos import (
+        ChurningClient,
+        SlowlorisClient,
+        client_schedule_digest,
+    )
+    from go_ibft_tpu.node.proof_api import ProofApiServer
+
+    cfg = parsed["config"]["fleet"]
+    seed = parsed["seed"]
+    churn_n = int(cfg.get("churn_clients", 0))
+    slow_n = int(cfg.get("slowloris_clients", 0))
+    slow_conns = int(cfg.get("slowloris_conns", 4))
+
+    class _NoProofs:
+        def get_proof(self, checkpoint, target=None):
+            raise RuntimeError("fleet replay serves no proofs")
+
+    api = ProofApiServer(
+        _NoProofs(),
+        lambda: 0,
+        port=0,
+        header_timeout_s=0.5,
+    )
+    port = api.start()
+    stop = threading.Event()
+    clients = [
+        ChurningClient("127.0.0.1", port, seed=seed, client_id=i)
+        for i in range(churn_n)
+    ] + [
+        SlowlorisClient(
+            "127.0.0.1", port, seed=seed, client_id=i, conns=slow_conns
+        )
+        for i in range(slow_n)
+    ]
+    threads = [
+        threading.Thread(target=c.run, args=(stop,), daemon=True)
+        for c in clients
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(window_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        api.stop()
+    churn = sum(c.stats["churns"] for c in clients[:churn_n])
+    opened = sum(c.stats["opened"] for c in clients[churn_n:])
+    cut = sum(c.stats["cut_by_server"] for c in clients[churn_n:])
+    uncut = max(0, opened - cut)
+    print(
+        f"replayed {churn_n} churn + {slow_n} slowloris clients for "
+        f"{window_s:.1f}s: churns={churn} slowloris opened={opened} "
+        f"cut_by_server={cut}",
+        flush=True,
+    )
+    digest = client_schedule_digest(seed, churn_n, slow_n)
+    if digest != parsed["schedule"]:
+        print(
+            f"DIGEST MISMATCH: line says {parsed['schedule']}, "
+            f"replay rebuilt {digest}",
+            flush=True,
+        )
+        return 2
+    print(f"schedule digest verified: {digest}", flush=True)
+    if uncut:
+        print(f"FAIL: {uncut} slowloris socket(s) never cut", flush=True)
+    return 1 if uncut else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=None)
@@ -262,6 +345,8 @@ def main() -> int:
 
         parsed = parse_replay_line(args.line)
         cfg = parsed["config"]
+        if "fleet" in cfg:
+            return replay_fleet(parsed)
         if "chaos" in cfg or "adversary" in cfg or "n_nodes" in cfg:
             return asyncio.run(
                 replay_cluster(

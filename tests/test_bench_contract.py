@@ -797,3 +797,54 @@ def test_byzantine_soak_schedule_membership_and_schema():
         assert spec.warn == 0 and spec.fail == 0, (
             "invariant SLOs must have zero tolerance"
         )
+
+
+def test_fleet_only_flag_scopes_evidence_contract():
+    """`bench.py --fleet-only` (the make fleet-bench entry) runs ONLY
+    config #17 and scopes the rc=0 evidence contract to it — static
+    check on _run, like the other --*-only pins.  The config launches
+    real subprocesses, so like #15/#16 it carries a driver-schedule
+    reserve and the scoped entry point is where it measures."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "fleet_only" in src
+    assert "config17_fleet" in src
+
+
+def test_fleet_schedule_membership_and_schema():
+    """Config #17's driver contract: it sits in BOTH schedules, owns the
+    multiprocess_fleet metric key, QoS-gates (missed heights, chain
+    divergence over the wire, slowloris cut rate) BEFORE publishing
+    proofs/s, emits the replayable CHAOS-REPLAY artifact, and its SLO
+    families carry standing limits in obs/gates.py."""
+    import inspect
+
+    from go_ibft_tpu.obs import gates
+
+    for schedule in (bench._FALLBACK_SCHEDULE, bench._DEVICE_SCHEDULE):
+        assert any(
+            fn.__name__ == "config17_fleet" for fn, _ in schedule
+        ), "config17 missing from a driver schedule"
+    assert bench.config17_fleet.metric == "multiprocess_fleet"
+    src = inspect.getsource(bench.config17_fleet)
+    for needle in (
+        "run_fleet",
+        "missed_heights",
+        "fleet_diverged_chains",
+        "fleet_slowloris_uncut",
+        "gate_slo_records",
+        "replay_line",
+        "verified_proofs",
+        "timeline_heights",
+    ):
+        assert needle in src, f"config17 lost its {needle} step"
+    # QoS gate precedes the evidence line
+    assert src.index("gate_slo_records") < src.index("_log(")
+    # zero-tolerance standing limits for the safety-shaped families
+    for key in ("fleet_diverged_chains", "fleet_slowloris_uncut"):
+        spec = gates.DEFAULT_SLO_TABLE[key]
+        assert spec.warn == 0 and spec.fail == 0
+    assert gates.DEFAULT_SLO_TABLE["fleet_proof_p99_ms"].fail is not None

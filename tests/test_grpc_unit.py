@@ -192,3 +192,123 @@ async def test_retry_jitter_is_seedable_and_deterministic():
     assert [seq_a.uniform(0.5, 1.5) for _ in range(8)] == [
         seq_b.uniform(0.5, 1.5) for _ in range(8)
     ]
+
+
+# -- peer reconnect after consecutive exhausted deadlines (ISSUE 19) ---------
+
+
+async def test_reconnect_rebuilds_channel_after_consecutive_giveups():
+    """Two exhausted send deadlines to the same peer must tear down and
+    recreate its channel (same target) and count a peer_reconnect — the
+    restarted-peer recovery path."""
+    from go_ibft_tpu.utils import metrics
+
+    metrics.reset()
+    t = GrpcTransport(
+        "127.0.0.1:0",
+        {},
+        lambda m: None,
+        send_deadline_s=0.02,
+        base_backoff_s=0.005,
+        retry_seed=7,
+        reconnect_after=2,
+    )
+    t.add_peer("peer", "127.0.0.1:1")  # nothing listens there
+    first_channel = t._channels["peer"]
+
+    async def stub(payload, timeout=None):
+        raise grpc.RpcError()
+
+    await t._send("peer", stub, b"x")  # streak 1: no reconnect yet
+    assert t._channels["peer"] is first_channel
+    assert (
+        metrics.get_counter(("go-ibft", "transport", "peer_reconnects")) == 0
+    )
+    await t._send("peer", stub, b"x")  # streak 2: reconnect
+    assert t._channels["peer"] is not first_channel
+    assert t._stubs["peer"] is not None
+    assert (
+        metrics.get_counter(("go-ibft", "transport", "peer_reconnects")) == 1
+    )
+    assert t._fail_streak["peer"] == 0  # fresh channel starts clean
+    await t.stop()
+
+
+async def test_reconnect_streak_resets_on_success():
+    """A successful send between failures resets the streak: transient
+    blips never churn healthy channels."""
+    from go_ibft_tpu.utils import metrics
+
+    metrics.reset()
+    t = GrpcTransport(
+        "127.0.0.1:0",
+        {},
+        lambda m: None,
+        send_deadline_s=0.02,
+        base_backoff_s=0.005,
+        retry_seed=7,
+        reconnect_after=2,
+    )
+    t.add_peer("peer", "127.0.0.1:1")
+    first_channel = t._channels["peer"]
+    calls = {"n": 0}
+
+    async def flaky(payload, timeout=None):
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:
+            raise grpc.RpcError()
+        return b""
+
+    async def dead(payload, timeout=None):
+        raise grpc.RpcError()
+
+    await t._send("peer", dead, b"x")  # streak 1
+    await t._send("peer", flaky, b"x")  # retries then succeeds: streak 0
+    assert "peer" not in t._fail_streak
+    await t._send("peer", dead, b"x")  # streak 1 again: still no reconnect
+    assert t._channels["peer"] is first_channel
+    assert (
+        metrics.get_counter(("go-ibft", "transport", "peer_reconnects")) == 0
+    )
+    await t.stop()
+
+
+async def test_reconnected_peer_delivers_again():
+    """End-to-end: kill a peer's transport, exhaust deadlines (forcing a
+    reconnect), restart the peer on the SAME port — the next multicast
+    lands.  The restarted-validator rejoin path over real sockets."""
+    got_b = []
+    ta = GrpcTransport(
+        "127.0.0.1:0",
+        {},
+        lambda m: None,
+        send_deadline_s=0.3,
+        base_backoff_s=0.01,
+        retry_seed=3,
+        reconnect_after=1,
+    )
+    tb = GrpcTransport("127.0.0.1:0", {}, got_b.append)
+    await ta.start()
+    await tb.start()
+    port_b = tb.bound_port
+    ta.add_peer("b", f"127.0.0.1:{port_b}")
+    try:
+        await tb.stop()  # peer restarts...
+        ta.multicast(_msg())  # ...meanwhile sends exhaust + reconnect
+        for _ in range(200):
+            if not ta._tasks:
+                break
+            await asyncio.sleep(0.02)
+        tb2 = GrpcTransport(f"127.0.0.1:{port_b}", {}, got_b.append)
+        await tb2.start()
+        try:
+            ta.multicast(_msg())
+            for _ in range(200):
+                if got_b:
+                    break
+                await asyncio.sleep(0.02)
+            assert got_b, "multicast after peer restart never delivered"
+        finally:
+            await tb2.stop()
+    finally:
+        await ta.stop()

@@ -319,3 +319,106 @@ def test_ring_dropped_surfaces_in_statusz():
         assert json.loads(text)["ring_dropped"] == rec.dropped > 0
     finally:
         runner.stop_telemetry()
+
+
+# ---------------------------------------------------------------------------
+# /readyz: liveness/readiness split (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_readyz_endpoint_defaults_ready_without_ready_fn():
+    server = TelemetryServer()
+    server.start()
+    try:
+        code, text = _get(server.url + "/readyz")
+        assert code == 200 and json.loads(text)["ready"] is True
+    finally:
+        server.stop()
+
+
+def test_readyz_serves_503_until_ready_fn_flips():
+    state = {"ready": False}
+    server = TelemetryServer(
+        ready_fn=lambda: (state["ready"], {"detail": "warming"})
+    )
+    server.start()
+    try:
+        code, text = _get(server.url + "/readyz")
+        assert code == 503 and json.loads(text)["ready"] is False
+        state["ready"] = True
+        code, text = _get(server.url + "/readyz")
+        assert code == 200 and json.loads(text)["ready"] is True
+    finally:
+        server.stop()
+
+
+def test_runner_readiness_transitions_recover_then_first_height(tmp_path):
+    """The supervisor contract (ISSUE 19): a node with a WAL is NOT ready
+    before ``recover()`` replays it, is STILL not ready before its first
+    height finalizes, and becomes ready once both held — while /healthz
+    (liveness) reports healthy the whole time (alive is not routable)."""
+    import os
+
+    from go_ibft_tpu.chain import ChainRunner, WriteAheadLog
+    from go_ibft_tpu.core import IBFT, LoopbackTransport
+    from go_ibft_tpu.crypto import PrivateKey
+    from go_ibft_tpu.crypto.backend import ECDSABackend
+    from go_ibft_tpu.verify import HostBatchVerifier
+
+    from harness import NullLogger
+
+    key = PrivateKey.from_seed(b"tel-ready")
+    src = ECDSABackend.static_validators({key.address: 1})
+    transport = LoopbackTransport()
+    engine = IBFT(
+        NullLogger(),
+        ECDSABackend(key, src),
+        transport,
+        batch_verifier=HostBatchVerifier(src),
+    )
+    transport.register(engine.add_message)
+    runner = ChainRunner(
+        engine,
+        WriteAheadLog(os.path.join(tmp_path, "wal.jsonl")),
+        overlap=False,
+    )
+    server = runner.start_telemetry(port=0)
+    try:
+        # 1. Booted, WAL not replayed: alive but NOT ready.
+        code, text = _get(server.url + "/readyz")
+        ready = json.loads(text)
+        assert code == 503 and ready["ready"] is False
+        assert ready["recovered"] is False
+        code, _ = _get(server.url + "/healthz")
+        assert code == 200  # liveness stays green: do not restart it
+
+        # 2. Recovered (empty WAL) but no height finalized yet: a node
+        # that cannot serve reads must still not be routed traffic.
+        runner.recover()
+        code, text = _get(server.url + "/readyz")
+        ready = json.loads(text)
+        assert code == 503 and ready["ready"] is False
+        assert ready["recovered"] is True and ready["chain_height"] == 0
+
+        # 3. First height finalized: ready.
+        asyncio.run(asyncio.wait_for(runner.run(until_height=1), 60))
+        code, text = _get(server.url + "/readyz")
+        ready = json.loads(text)
+        assert code == 200 and ready["ready"] is True
+        assert ready["chain_height"] >= 1
+    finally:
+        runner.stop_telemetry()
+        engine.messages.close()
+
+
+def test_runner_readiness_no_wal_requires_only_first_height():
+    """Without a WAL there is nothing to recover: readiness reduces to
+    the first-finalized-height condition."""
+    runner = _mini_runner()
+    runner.engine.transport.register(runner.engine.add_message)
+    ready, payload = runner.telemetry_ready()
+    assert ready is False and payload["recovered"] is True
+    asyncio.run(asyncio.wait_for(runner.run(until_height=1), 60))
+    ready, payload = runner.telemetry_ready()
+    assert ready is True and payload["chain_height"] >= 1
+    runner.engine.messages.close()

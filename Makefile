@@ -5,7 +5,7 @@
 	compile-budget-check ab-keccak tenant-bench sched-soak latency-smoke \
 	serve-bench timeline-smoke slo-gates multipair-bench cost-report \
 	boot-bench boot-check byzantine-smoke byzantine-soak fleet-bench \
-	fleet-smoke
+	fleet-smoke checkpoint-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -221,6 +221,21 @@ fleet-smoke:
 	python scripts/fleet.py --nodes 2 --heights 2 --connections 16 \
 		--churn-clients 1 --slowloris-clients 1 --think-s 0.2 \
 		--min-flood-s 1.5
+
+# Checkpoint cold-sync smoke (config #18, fast-tier CI): real-crypto
+# epoch checkpoint certificates + O(log n) skip sync over a live HTTP
+# proof API, SLO-gated before timing — <= 4 batched pairing dispatches,
+# checkpoint bytes <= 1% of the same-run linear diff-walk baseline, and
+# the fabricated-diff splice attack rejected at the commitment check.
+# Scaled down for the fast tier (the 1M-height structural shape runs at
+# the bench defaults); GO_IBFT_CKPT_HEIGHTS / _SPACING / _CLIENTS /
+# _DEPTH_POOL / _SEED scale it.
+checkpoint-smoke:
+	JAX_PLATFORMS=cpu \
+	GO_IBFT_BENCH_BUDGET_S=600 \
+	GO_IBFT_CKPT_HEIGHTS=100000 GO_IBFT_CKPT_SPACING=500 \
+	GO_IBFT_CKPT_CLIENTS=2000 GO_IBFT_CKPT_DEPTH_POOL=4 \
+	python bench.py --checkpoint-only
 
 # Slow-tier byzantine soak: 3 seeds x the full strategy matrix at 12
 # validators over WAN chaos, every invariant checked every tick

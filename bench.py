@@ -3387,6 +3387,318 @@ def config17_fleet() -> None:
     )
 
 
+def config18_checkpoint_sync() -> None:
+    """Checkpoint-anchored cold sync (config #18, ISSUE 20): epoch
+    checkpoint certificates + O(log n) skip links turn a million-height
+    cold sync into a handful of certificate bytes verified in ONE
+    batched pairing dispatch.  Three phases, every gate BEFORE timing:
+
+    * **structural 1M** — GO_IBFT_CKPT_HEIGHTS simulated heights
+      checkpointed every GO_IBFT_CKPT_SPACING (lazy-signed: only the
+      O(log n) skip path pays BLS signing), served over a REAL
+      ``ProofApiServer`` HTTP socket; a ``CheckpointClient`` cold-syncs
+      from genesis trust.  The linear diff-walk baseline is the
+      per-height proof-entry wire cost measured from the real phase-2
+      chain in the same run, times the height count.  Gates: checkpoint
+      bytes <= 1% of the linear baseline (>= 100x) and the whole skip
+      chain verified in <= 4 batched pairing dispatches.
+    * **real crypto end to end** — a 16-height commitment-carrying
+      ECDSA chain with a mid-epoch validator rotation, checkpointed
+      every 4 heights with eager BLS quorum seals; HTTP cold sync
+      bridges the rotation hop with a commitment-enforced finality
+      proof.  The fabricated-diff splice attack — a rotation diff
+      spliced into the FETCHED wire payload — must die at the
+      commitment check (gated, not just asserted in tests).
+    * **anchor-depth cache** — GO_IBFT_CKPT_CLIENTS clients anchor at
+      random epoch depths (GO_IBFT_CKPT_DEPTH_POOL distinct): the first
+      client on a path pays the lazy BLS signing, the rest hit the
+      record cache; reports signatures amortized + fetch p50.
+    """
+    import random as _random
+    import threading as _threading
+    import time as _time
+
+    from go_ibft_tpu.bench.workload import _keys
+    from go_ibft_tpu.chain.wal import FinalizedBlock
+    from go_ibft_tpu.core.validator_manager import calculate_quorum
+    from go_ibft_tpu.crypto import bls as hbls
+    from go_ibft_tpu.crypto import ecdsa as _ec
+    from go_ibft_tpu.crypto.backend import encode_signature, proposal_hash_of
+    from go_ibft_tpu.crypto.keccak import keccak256
+    from go_ibft_tpu.crypto.quorum_cert import BLSKeyRegistry
+    from go_ibft_tpu.lightsync import (
+        CheckpointClient,
+        Checkpointer,
+        embed_next_set,
+        set_root,
+        skip_path,
+    )
+    from go_ibft_tpu.messages.helpers import CommittedSeal
+    from go_ibft_tpu.messages.wire import Proposal
+    from go_ibft_tpu.node.proof_api import ProofApiServer
+    from go_ibft_tpu.obs import gates
+    from go_ibft_tpu.serve import (
+        ProofBuilder,
+        ProofCache,
+        ProofError,
+        ProofServer,
+        ProofVerifier,
+    )
+    from go_ibft_tpu.serve.proof import FinalityProof
+
+    spacing = int(os.environ.get("GO_IBFT_CKPT_SPACING", "1000"))
+    epochs = max(
+        1, int(os.environ.get("GO_IBFT_CKPT_HEIGHTS", "1000000")) // spacing
+    )
+    heights = epochs * spacing  # head lands ON a boundary: pure-cert sync
+    n_clients = int(os.environ.get("GO_IBFT_CKPT_CLIENTS", "10000"))
+    depth_pool = int(os.environ.get("GO_IBFT_CKPT_DEPTH_POOL", "8"))
+    seed = int(os.environ.get("GO_IBFT_CKPT_SEED", "7"))
+
+    # -- phase 1: structural 1M over a real HTTP socket -------------------
+    vaddrs = [b"ckpt-val-%02d" % i for i in range(4)]
+    bls_keys = {
+        a: hbls.BLSPrivateKey.from_seed(b"bench-ckpt-bls-%d" % i)
+        for i, a in enumerate(vaddrs)
+    }
+    powers = {a: 1 for a in vaddrs}
+    registry = BLSKeyRegistry()
+    for a, k in bls_keys.items():
+        registry.register_key(a, k)
+    checkpointer = Checkpointer(
+        spacing, lambda _h: powers, signers=bls_keys, lazy_sign=True
+    )
+    t0 = _time.perf_counter()
+    for e in range(1, epochs + 1):
+        h = e * spacing
+        checkpointer.on_finalize(h, keccak256(b"ckpt blk %d" % h))
+    build_s = _time.perf_counter() - t0
+
+    api = ProofApiServer(
+        None, lambda: heights, checkpoints_fn=checkpointer.wire_payload
+    )
+    api.start()
+    try:
+        client = CheckpointClient(api.url, registry)
+        t0 = _time.perf_counter()
+        report = client.cold_sync(powers)
+        sync_s = _time.perf_counter() - t0
+    finally:
+        api.stop()
+    assert report.anchor_height == heights and report.tail_bytes == 0, (
+        f"structural sync anchored at {report.anchor_height}/{heights} "
+        f"with {report.tail_bytes} tail bytes — expected a pure-cert sync"
+    )
+    assert report.checkpoint_lanes == len(skip_path(epochs)), (
+        f"{report.checkpoint_lanes} lanes for {epochs} epochs"
+    )
+
+    # -- phase 2: real-crypto chain, rotation bridge, splice attack -------
+    real_spacing = 4
+    real_heights = 16
+    rotate_at = 10  # mid-epoch: the bridge proof carries the diff
+    keys = _keys(5, seed=31)
+    set_a = {k.address: 1 for k in keys[:4]}
+    set_b = {k.address: 1 for k in keys[1:5]}
+
+    def validators_for_height(h: int) -> dict:
+        return dict(set_b if h >= rotate_at else set_a)
+
+    by_addr = {k.address: k for k in keys}
+    quorum = calculate_quorum(4)
+    blocks = []
+    for h in range(1, real_heights + 1):
+        raw = embed_next_set(
+            b"ckpt bench block %d" % h,
+            set_root(validators_for_height(h + 1)),
+        )
+        proposal = Proposal(raw_proposal=raw, round=0)
+        phash = proposal_hash_of(proposal)
+        members = sorted(validators_for_height(h))
+        blocks.append(
+            FinalizedBlock(
+                h,
+                proposal,
+                [
+                    CommittedSeal(
+                        signer=a,
+                        signature=encode_signature(
+                            *_ec.sign(by_addr[a], phash)
+                        ),
+                    )
+                    for a in members[:quorum]
+                ],
+            )
+        )
+    real_bls = {
+        k.address: hbls.BLSPrivateKey.from_seed(b"bench-ckpt-real-%d" % i)
+        for i, k in enumerate(keys)
+    }
+    real_registry = BLSKeyRegistry()
+    for a, k in real_bls.items():
+        real_registry.register_key(a, k)
+    real_ckpt = Checkpointer(
+        real_spacing, validators_for_height, signers=real_bls
+    )
+    for block in blocks:
+        real_ckpt.on_finalize(
+            block.height, proposal_hash_of(block.proposal)
+        )
+    source = _ListSyncSource(blocks)
+    server = ProofServer(
+        ProofBuilder(source, validators_for_height),
+        ProofCache(chunk_heights=4),
+    )
+    api2 = ProofApiServer(
+        server, source.latest_height, checkpoints_fn=real_ckpt.wire_payload
+    )
+    api2.start()
+    try:
+        client2 = CheckpointClient(api2.url, real_registry)
+        report2 = client2.cold_sync(set_a)
+        assert report2.anchor_height == real_heights, report2
+        assert report2.bridge_bytes > 0, (
+            "rotation crossed with no bridge proof — the hop check is dead"
+        )
+        assert report2.powers == set_b, "cold sync derived the wrong set"
+
+        # The fabricated-diff splice attack, end to end through the wire:
+        # fetch a REAL bridge proof, splice a rotation diff granting an
+        # attacker majority power, verify client-side with commitments
+        # enforced.  It must die at the commitment check (walk_sets),
+        # BEFORE any signature work sees it.
+        payload, _nb = client2.fetch_proof(real_spacing * 2, real_heights)
+        payload["proof"]["diffs"].append(
+            {
+                "height": real_heights - 1,
+                "added": {"ab" * 20: 1000},
+                "removed": [],
+            }
+        )
+        spliced = FinalityProof.from_wire(payload["proof"])
+        try:
+            ProofVerifier(require_commitments=True).verify(
+                spliced, validators_for_height(real_spacing * 2)
+            )
+        except ProofError as err:
+            splice_error = str(err)
+        else:
+            raise AssertionError(
+                "fabricated-diff splice VERIFIED — commitment gate is dead"
+            )
+        assert "next-set root" in splice_error, splice_error
+
+        # Linear diff-walk baseline measured over the SAME wire: real
+        # per-height proof-entry bytes, scaled to the structural height
+        # count (entry bytes dominate; diffs only add to them).
+        _full, full_bytes = client2.fetch_proof(0, real_heights)
+    finally:
+        api2.stop()
+    linear_bytes = int(full_bytes / real_heights * heights)
+    ratio = linear_bytes / max(1, report.total_bytes)
+
+    # -- phase 3: anchor-depth cache over the lazy checkpointer -----------
+    rng = _random.Random(seed)
+    depths = [rng.randint(1, epochs) for _ in range(depth_pool)]
+    signed_before = sum(
+        1
+        for e in range(1, epochs + 1)
+        if (rec := checkpointer.record(e)) is not None and rec.signed
+    )
+    served = 0
+    fetch_us = []
+    lock = _threading.Lock()
+
+    def anchor_client(i: int) -> None:
+        nonlocal served
+        t0 = _time.perf_counter()
+        payload = checkpointer.wire_payload(
+            target_epoch=depths[i % depth_pool]
+        )
+        dt = (_time.perf_counter() - t0) * 1e6
+        with lock:
+            served += len(payload["checkpoints"])
+            fetch_us.append(dt)
+
+    t0 = _time.perf_counter()
+    for i in range(n_clients):
+        anchor_client(i)
+    clients_s = _time.perf_counter() - t0
+    signed_after = sum(
+        1
+        for e in range(1, epochs + 1)
+        if (rec := checkpointer.record(e)) is not None and rec.signed
+    )
+    fresh_signed = signed_after - signed_before
+    hit_rate = 1.0 - fresh_signed / max(1, served)
+    fetch_us.sort()
+    fetch_p50_us = fetch_us[len(fetch_us) // 2]
+
+    records = [
+        gates.slo_record(
+            "checkpoint_sync_dispatches",
+            report.pairing_dispatches,
+            fail=4.0,
+            context={"epochs": epochs, "lanes": report.checkpoint_lanes},
+        ),
+        gates.slo_record(
+            "checkpoint_real_sync_dispatches",
+            report2.pairing_dispatches,
+            fail=4.0,
+            context={"heights": real_heights, "spacing": real_spacing},
+        ),
+        gates.slo_record(
+            "checkpoint_bytes_fraction_of_linear",
+            report.total_bytes / max(1, linear_bytes),
+            fail=0.01,
+            context={
+                "checkpoint_bytes": report.total_bytes,
+                "linear_baseline_bytes": linear_bytes,
+            },
+        ),
+    ]
+    graded = gates.gate_slo_records(records)
+    slo_failures = [g for g in graded if g.status == "fail"]
+    assert not slo_failures, f"SLO gate failures: {slo_failures}"
+
+    _log(
+        {
+            "metric": config18_checkpoint_sync.metric,
+            "value": round(ratio, 1),
+            "unit": "x_bytes_vs_linear_walk",
+            "vs_baseline": None,
+            "variant": "cpu-fallback" if _FALLBACK else "device",
+            "heights": heights,
+            "spacing": spacing,
+            "epochs": epochs,
+            "checkpoint_bytes": report.total_bytes,
+            "linear_baseline_bytes": linear_bytes,
+            "checkpoint_lanes": report.checkpoint_lanes,
+            "pairing_dispatches": report.pairing_dispatches,
+            "chain_build_s": round(build_s, 3),
+            "cold_sync_s": round(sync_s, 3),
+            "real": {
+                "heights": real_heights,
+                "spacing": real_spacing,
+                "rotation_height": rotate_at,
+                "total_bytes": report2.total_bytes,
+                "bridge_bytes": report2.bridge_bytes,
+                "pairing_dispatches": report2.pairing_dispatches,
+                "splice_rejected": True,
+            },
+            "clients": {
+                "count": n_clients,
+                "depth_pool": depth_pool,
+                "records_served": served,
+                "fresh_signatures": fresh_signed,
+                "cache_hit_rate": round(hit_rate, 4),
+                "fetch_p50_us": round(fetch_p50_us, 1),
+                "elapsed_s": round(clients_s, 3),
+            },
+        }
+    )
+
+
 def _guarded(config_fn, failures: list, reserve_s: float = 0.0) -> None:
     """Secondary configs must not take down the headline: report the
     failure as a JSON line and keep going.  The differential smoke and the
@@ -3449,6 +3761,7 @@ config14_boot_warm_start.metric = "boot_warm_start"
 config15_cluster.metric = "cluster_lockstep_100v"
 config16_byzantine_soak.metric = "byzantine_soak_100v"
 config17_fleet.metric = "multiprocess_fleet"
+config18_checkpoint_sync.metric = "checkpoint_sync_1m"
 # Fallback variants report under the same BASELINE.md metric keys (one line
 # per config on EVERY backend), self-labeled via their "variant" field.
 config3_host_scaled.metric = config3_pipelined.metric
@@ -3476,6 +3789,12 @@ _FALLBACK_SCHEDULE = (
     (config11_commit_critical_path, 95.0),
     (config12_proof_serving, 65.0),
     (config13_multipair, 35.0),
+    # Config #18 pays ~200 pure-Python BLS G2 signs (lazy skip-path +
+    # eager real-crypto epochs + the anchor-depth cache pool) plus one
+    # 16-height ECDSA chain: ~20-40 s on the host route.  It sits in
+    # front of the #17/#16/#15/#14 skip ladder; `make checkpoint-smoke`
+    # (--checkpoint-only) measures it scoped.
+    (config18_checkpoint_sync, 470.0),
     # Config #17 launches 4 real validator subprocesses + the client
     # fleet (~20-40 s end to end including process boots); it sits in
     # front of the #16/#15/#14 skip ladder so a tight driver budget
@@ -3523,6 +3842,7 @@ _DEVICE_SCHEDULE = (
     (config11_commit_critical_path, 350.0),
     (config12_proof_serving, 330.0),
     (config13_multipair, 310.0),
+    (config18_checkpoint_sync, 309.5),
     (config17_fleet, 309.0),
     (config16_byzantine_soak, 308.0),
     (config15_cluster, 305.0),
@@ -3675,6 +3995,18 @@ def main(argv=None) -> None:
         "before timing; GO_IBFT_FLEET_NODES / GO_IBFT_FLEET_HEIGHTS / "
         "GO_IBFT_FLEET_CONNS / GO_IBFT_FLEET_CHURN / GO_IBFT_FLEET_SLOW "
         "/ GO_IBFT_FLEET_SEED / GO_IBFT_FLEET_THINK_S scale it)",
+    )
+    parser.add_argument(
+        "--checkpoint-only",
+        action="store_true",
+        help="run ONLY the checkpoint cold-sync config (#18); the rc=0 "
+        "evidence contract scopes to it (the `make checkpoint-smoke` "
+        "entry point — O(log n) certificate skip sync vs the linear "
+        "diff-walk baseline over a real HTTP proof API, dispatch count "
+        "pinned, the fabricated-diff splice attack gated; "
+        "GO_IBFT_CKPT_HEIGHTS / GO_IBFT_CKPT_SPACING / "
+        "GO_IBFT_CKPT_CLIENTS / GO_IBFT_CKPT_DEPTH_POOL / "
+        "GO_IBFT_CKPT_SEED scale it)",
     )
     parser.add_argument(
         "--byzantine-only",
@@ -3902,6 +4234,20 @@ def _run(args) -> None:
         failures = []
         _guarded(config17_fleet, failures, reserve_s=0.0)
         missing = _EVIDENCE.missing((config17_fleet.metric,))
+        if missing:
+            _log({"metric": "bench_evidence_gap", "value": missing})
+        if failures:
+            _log({"metric": "bench_failures", "value": failures})
+        sys.exit(1 if failures or missing else 0)
+
+    if args.checkpoint_only:
+        # Scoped run for `make checkpoint-smoke`: only config #18, rc=0
+        # iff its evidence line landed.  The config gates the dispatch
+        # pins, the >= 100x bytes-vs-linear ratio, and the end-to-end
+        # fabricated-diff splice rejection before publishing any number.
+        failures = []
+        _guarded(config18_checkpoint_sync, failures, reserve_s=0.0)
+        missing = _EVIDENCE.missing((config18_checkpoint_sync.metric,))
         if missing:
             _log({"metric": "bench_evidence_gap", "value": missing})
         if failures:

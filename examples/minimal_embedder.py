@@ -47,6 +47,7 @@ def build_cluster(
     use_mesh: bool = False,
     use_aggregate: bool = False,
     use_speculate: bool = False,
+    commit_next_set: bool = False,
 ):
     # 1. Validator identities and the (static) voting-power map.
     keys = [PrivateKey.from_seed(b"example-validator-%d" % i) for i in range(n)]
@@ -101,7 +102,12 @@ def build_cluster(
         else:
             # The embedder's block builder: anything bytes. A real chain
             # would assemble transactions here (reference Backend.BuildProposal).
-            backend = ECDSABackend(key, validators, build_proposal_fn=build)
+            backend = ECDSABackend(
+                key,
+                validators,
+                build_proposal_fn=build,
+                commit_next_set=commit_next_set,
+            )
         batch_verifier = None
         if use_mesh:
             # Production scale-out posture: the adaptive router with the
@@ -302,7 +308,9 @@ async def main_chain(
     _print_chains(engines)
 
 
-def main_serve(n: int, heights: int, clients: int) -> None:
+def main_serve(
+    n: int, heights: int, clients: int, checkpoint_spacing: int = 0
+) -> None:
     """Proof-serving mode (``--serve N``): run a chain to finality, then
     serve finality proofs to N synthetic light clients.
 
@@ -313,6 +321,14 @@ def main_serve(n: int, heights: int, clients: int) -> None:
     from N client threads, each verifying its proof against the trusted
     genesis checkpoint.  Prints proofs/s and the cache hit rates — the
     docs/SERVING.md read-plane story at toy scale.
+
+    ``--checkpoint-spacing S`` (ISSUE 20) additionally seals an epoch
+    checkpoint certificate every S heights, serves the skip chain over a
+    real HTTP :class:`~go_ibft_tpu.node.proof_api.ProofApiServer`, and
+    cold-syncs a :class:`~go_ibft_tpu.lightsync.CheckpointClient`
+    against it — printing checkpoint-anchored vs full-walk sync bytes.
+    Proposals then carry next-set commitments so the tail proof verifies
+    with ``require_commitments`` on (the fabricated-diff defense).
     """
     import threading
     import time
@@ -320,7 +336,9 @@ def main_serve(n: int, heights: int, clients: int) -> None:
     from go_ibft_tpu.chain import ChainRunner
     from go_ibft_tpu.serve import ProofBuilder, ProofCache, ProofServer
 
-    engines, _certifier, _hub = build_cluster(n, use_device=False)
+    engines, _certifier, _hub = build_cluster(
+        n, use_device=False, commit_next_set=checkpoint_spacing > 0
+    )
     runners = [ChainRunner(engine, overlap=False) for engine in engines]
 
     async def drive() -> None:
@@ -371,6 +389,65 @@ def main_serve(n: int, heights: int, clients: int) -> None:
         f"sig-verdict cache hit rate "
         f"{stats['verify']['sig_cache']['hit_rate']}"
     )
+
+    if checkpoint_spacing <= 0:
+        return
+
+    # -- ISSUE 20: checkpoint-anchored cold sync over real HTTP ----------
+    from go_ibft_tpu.crypto import bls as hbls
+    from go_ibft_tpu.crypto.backend import proposal_hash_of
+    from go_ibft_tpu.crypto.quorum_cert import BLSKeyRegistry
+    from go_ibft_tpu.lightsync import CheckpointClient, Checkpointer
+    from go_ibft_tpu.node.proof_api import ProofApiServer
+
+    # Epoch certificates are BLS-sealed; register PoP-gated keys for the
+    # same validator identities (rogue-key defense lives in the registry).
+    addrs = sorted(source.validators_for_height(1))
+    bls_signers = {
+        a: hbls.BLSPrivateKey.from_seed(b"example-ckpt-bls-%d" % i)
+        for i, a in enumerate(addrs)
+    }
+    registry = BLSKeyRegistry()
+    for a, k in bls_signers.items():
+        registry.register_key(a, k)
+    checkpointer = Checkpointer(
+        checkpoint_spacing, source.validators_for_height, signers=bls_signers
+    )
+    for block in source.get_blocks(1, source.latest_height()):
+        checkpointer.on_finalize(
+            block.height, proposal_hash_of(block.proposal)
+        )
+
+    api = ProofApiServer(
+        server,
+        source.latest_height,
+        checkpoints_fn=checkpointer.wire_payload,
+    )
+    api.start()
+    try:
+        light = CheckpointClient(api.url, registry)
+        genesis_powers = source.validators_for_height(1)
+        report = light.cold_sync(genesis_powers)
+        # Full-walk baseline over the SAME wire: one finality proof from
+        # the genesis trust anchor, every height a diff hop.
+        _, full_walk_bytes = light.fetch_proof(0, report.target)
+        print(
+            f"checkpoint sync (spacing {checkpoint_spacing}): anchored at "
+            f"height {report.anchor_height} (epoch {report.anchor_epoch}), "
+            f"skipped {report.heights_skipped} heights, "
+            f"{report.pairing_dispatches} batched pairing dispatch(es)"
+        )
+        print(
+            f"  checkpoint-anchored: {report.total_bytes} bytes "
+            f"(certs {report.checkpoint_bytes} + bridges "
+            f"{report.bridge_bytes} + tail {report.tail_bytes})"
+        )
+        print(
+            f"  full walk from genesis: {full_walk_bytes} bytes "
+            f"({full_walk_bytes / max(1, report.total_bytes):.1f}x)"
+        )
+    finally:
+        api.stop()
 
 
 def main_tenants(n: int, heights: int, tenants: int) -> None:
@@ -471,8 +548,15 @@ class _QuietLogger:
 
 
 def _print_chains(engines) -> None:
+    from go_ibft_tpu.lightsync import strip_next_set
+
     for i, e in enumerate(engines):
-        chain = [p.raw_proposal.decode() for p, _seals in e.backend.inserted]
+        # Commitment-carrying proposals end in a 52-byte binary suffix
+        # (lightsync.commitment) — strip it for the human-readable chain.
+        chain = [
+            strip_next_set(p.raw_proposal).decode()
+            for p, _seals in e.backend.inserted
+        ]
         _p, last_seals = e.backend.inserted[-1]
         if e.finalized_certificate is not None:
             evidence = (
@@ -557,9 +641,20 @@ if __name__ == "__main__":
         "ProofServer mounted on the chain (docs/SERVING.md); prints "
         "proofs/s and cache hit rates",
     )
+    ap.add_argument(
+        "--checkpoint-spacing",
+        type=int,
+        default=0,
+        metavar="S",
+        help="(--serve mode) seal an epoch checkpoint certificate every S "
+        "heights and cold-sync a CheckpointClient over real HTTP; prints "
+        "checkpoint-anchored vs full-walk sync bytes (docs/SERVING.md)",
+    )
     args = ap.parse_args()
     if args.serve:
-        main_serve(args.nodes, args.heights, args.serve)
+        main_serve(
+            args.nodes, args.heights, args.serve, args.checkpoint_spacing
+        )
     elif args.tenants:
         main_tenants(args.nodes, args.heights, args.tenants)
     else:

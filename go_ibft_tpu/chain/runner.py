@@ -73,6 +73,7 @@ class ChainRunner:
         *,
         sync: Optional[SyncClient] = None,
         certifier=None,
+        checkpointer=None,
         speculator=None,
         overlap: bool = True,
         overlap_poll_s: float = 0.002,
@@ -93,6 +94,14 @@ class ChainRunner:
         # quorum formed).  Peers then serve certificate blocks and the
         # sync client re-verifies each height with ONE pairing.
         self.certifier = certifier
+        # Epoch checkpointing (ISSUE 20): a checkpointer (a
+        # :class:`~go_ibft_tpu.lightsync.checkpoint.Checkpointer`)
+        # builds the quorum-sealed skip-chain record at every epoch
+        # boundary the runner finalizes, persists it through the WAL
+        # (``append_checkpoint``), and serves the ``GET /checkpoints``
+        # payload through the proof API.  ``recover()`` restores the
+        # durable records so a restarted node never re-signs history.
+        self.checkpointer = checkpointer
         # Speculative verification plane (ISSUE 9): attaching a
         # :class:`~go_ibft_tpu.verify.speculate.SpeculativeVerifier`
         # here wires it into the engine — ingress COMMIT seals verify
@@ -236,6 +245,17 @@ class ChainRunner:
         self._append_block(
             FinalizedBlock(height, proposal, stored_seals, cert=cert)
         )
+        if self.checkpointer is not None:
+            from ..crypto.backend import proposal_hash_of
+
+            # Epoch boundary: build the skip-chain record AFTER the
+            # finalize record is durable (a checkpoint must never outlive
+            # a crash that lost the height it commits to).
+            rec = self.checkpointer.on_finalize(
+                height, proposal_hash_of(proposal)
+            )
+            if rec is not None and self.wal is not None:
+                self.wal.append_checkpoint(rec)
 
     def _on_lock(
         self,
@@ -265,6 +285,8 @@ class ChainRunner:
         for block in state.blocks:
             self.engine.backend.insert_proposal(block.proposal, block.seals)
             self._append_block(block)
+        if self.checkpointer is not None and state.checkpoints:
+            self.checkpointer.restore(state.checkpoints)
         self.height = state.next_height
         self._restore = None
         self._recovered = True
@@ -598,6 +620,16 @@ class ChainRunner:
                     block.height, block.proposal, block.seals, cert=block.cert
                 )
             self._append_block(block)
+            if self.checkpointer is not None:
+                from ..crypto.backend import proposal_hash_of
+
+                # A synced epoch boundary checkpoints too — catch-up must
+                # not leave holes in the skip chain.
+                rec = self.checkpointer.on_finalize(
+                    block.height, proposal_hash_of(block.proposal)
+                )
+                if rec is not None and self.wal is not None:
+                    self.wal.append_checkpoint(rec)
         if blocks:
             self.synced_heights += len(blocks)
             self.height = blocks[-1].height + 1

@@ -94,11 +94,18 @@ class WalLock:
 
 @dataclass
 class WalState:
-    """Replay result: the durable chain plus the live lock (if any)."""
+    """Replay result: the durable chain plus the live lock (if any).
+
+    ``checkpoints`` are the epoch checkpoint records (ISSUE 20,
+    :class:`~go_ibft_tpu.lightsync.checkpoint.CheckpointRecord`) the
+    node built at epoch boundaries — replayed so a restarted node serves
+    its skip chain without re-signing history.
+    """
 
     blocks: List[FinalizedBlock] = field(default_factory=list)
     lock: Optional[WalLock] = None
     dropped_tail: bool = False
+    checkpoints: List[object] = field(default_factory=list)
 
     @property
     def next_height(self) -> int:
@@ -209,6 +216,21 @@ class WriteAheadLog:
             record["pc"] = certificate.encode().hex()
         self._append(record, fsync=self._fsync_locks)
 
+    def append_checkpoint(self, record) -> None:
+        """Durably record one epoch checkpoint (ISSUE 20; fsync — the
+        record chains into every later epoch's skip links, so losing it
+        would orphan the structure on restart).  ``record`` is a
+        :class:`~go_ibft_tpu.lightsync.checkpoint.CheckpointRecord`."""
+        self._append(
+            {
+                "kind": "checkpoint",
+                "epoch": record.epoch,
+                "height": record.height,
+                "rec": record.encode().hex(),
+            },
+            fsync=True,
+        )
+
     def close(self) -> None:
         with self._lock:
             if self._fh is not None and not self._fh.closed:
@@ -253,6 +275,12 @@ class WriteAheadLog:
                     else None
                 ),
             )
+        if kind == "checkpoint":
+            # Lazy import, like the certificate codec: checkpoint-less
+            # WALs never pay for the lightsync stack.
+            from ..lightsync.checkpoint import CheckpointRecord
+
+            return CheckpointRecord.decode(bytes.fromhex(record["rec"]))
         raise ValueError(f"unknown WAL record kind {kind!r}")
 
     def _truncate_tail(self, data: bytes, torn: bytes) -> None:
@@ -319,8 +347,12 @@ class WriteAheadLog:
                 if state.blocks and parsed.height <= state.blocks[-1].height:
                     continue  # duplicate/stale re-append: first write wins
                 state.blocks.append(parsed)
-            else:
+            elif isinstance(parsed, WalLock):
                 latest_lock = parsed
+            else:  # checkpoint record (first write wins, like finalizes)
+                if any(c.epoch == parsed.epoch for c in state.checkpoints):
+                    continue
+                state.checkpoints.append(parsed)
         if latest_lock is not None and (
             not state.blocks or latest_lock.height > state.blocks[-1].height
         ):

@@ -90,6 +90,8 @@ class ECDSABackend:
         key: ec.PrivateKey,
         validators_for_height: Callable[[int], Mapping[bytes, int]],
         build_proposal_fn: Optional[Callable[[View], bytes]] = None,
+        *,
+        commit_next_set: bool = False,
     ):
         _try_native_fast_paths()
         self.key = key
@@ -98,6 +100,13 @@ class ECDSABackend:
         self._build_proposal_fn = build_proposal_fn or (
             lambda view: b"block %d" % view.height
         )
+        # ISSUE 20: when on, every built proposal carries a next-set
+        # commitment suffix over validators_for_height(height + 1), and
+        # is_valid_proposal requires the suffix frame to be present.  The
+        # engine seam passes only raw bytes (no height), so this side
+        # checks presence; the exact-root check happens where the height
+        # is known — serve.proof.walk_sets(require_commitments=True).
+        self.commit_next_set = commit_next_set
         self.inserted: list[tuple[Proposal, list[CommittedSeal]]] = []
 
     @staticmethod
@@ -180,7 +189,13 @@ class ECDSABackend:
     # -- Verifier (reference core/backend.go:37-56) ---------------------
 
     def is_valid_proposal(self, raw_proposal: bytes) -> bool:
-        return bool(raw_proposal)
+        if not raw_proposal:
+            return False
+        if self.commit_next_set:
+            from ..lightsync.commitment import extract_next_set
+
+            return extract_next_set(raw_proposal) is not None
+        return True
 
     def is_valid_validator(self, msg: IbftMessage) -> bool:
         if msg.view is None or len(msg.signature) != SIG_BYTES:
@@ -244,7 +259,12 @@ class ECDSABackend:
         pass
 
     def build_proposal(self, view: View) -> bytes:
-        return self._build_proposal_fn(view)
+        raw = self._build_proposal_fn(view)
+        if self.commit_next_set:
+            from ..lightsync.commitment import embed_next_set, set_root
+
+            raw = embed_next_set(raw, set_root(self._validators(view.height + 1)))
+        return raw
 
     def insert_proposal(
         self, proposal: Proposal, committed_seals: Sequence[CommittedSeal]

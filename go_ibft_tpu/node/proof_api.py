@@ -100,6 +100,16 @@ class ProofApiServer:
     503 until the node is routable (the /readyz condition) so a
     warm-starting node never serves a stale chain to a client that
     found it before the load balancer did.
+
+    ``checkpoints_fn`` (ISSUE 20) serves ``GET /checkpoints`` — the
+    epoch skip-chain payload a
+    :class:`~go_ibft_tpu.lightsync.client.CheckpointClient` anchors on.
+    Wire a :class:`~go_ibft_tpu.lightsync.checkpoint.Checkpointer`'s
+    ``wire_payload`` here; without one the route answers 404.  Query
+    params: ``epoch=<N>`` descends the skip path to epoch N instead of
+    the latest, ``all=1`` serves the full linear epoch list (the
+    measured baseline shape).  Builds run on the worker pool — lazy
+    signing may pay pure-Python G2 work, never on the IO thread.
     """
 
     def __init__(
@@ -115,10 +125,12 @@ class ProofApiServer:
         idle_timeout_s: float = 30.0,
         workers: int = 2,
         ready_fn: Optional[Callable[[], Tuple[bool, dict]]] = None,
+        checkpoints_fn: Optional[Callable[..., dict]] = None,
     ) -> None:
         self._proofs = proof_server
         self._head_fn = head_fn
         self._ready_fn = ready_fn
+        self._checkpoints_fn = checkpoints_fn
         self._host = host
         self._want_port = port
         self.max_connections = max_connections
@@ -149,6 +161,7 @@ class ProofApiServer:
             "oversize_requests": 0,
             "bad_requests": 0,
             "not_ready": 0,
+            "checkpoints_served": 0,
         }
 
     # -- lifecycle ------------------------------------------------------
@@ -443,6 +456,34 @@ class ProofApiServer:
         if path == "/head":
             self._respond(conn, 200, {"head": self._head_fn()})
             return
+        if path == "/checkpoints":
+            if self._checkpoints_fn is None:
+                self._respond(conn, 404, {"error": "not found", "path": path})
+                return
+            if self._ready_fn is not None:
+                ready, _payload = self._ready_fn()
+                if not ready:
+                    self._count("not_ready")
+                    self._respond(conn, 503, {"error": "not ready"})
+                    return
+            params = {}
+            for pair in query.split("&"):
+                name, _, value = pair.partition("=")
+                if name:
+                    params[name] = value
+            try:
+                epoch = (
+                    int(params["epoch"]) if params.get("epoch") else None
+                )
+            except ValueError:
+                self._respond(conn, 400, {"error": "epoch must be an integer"})
+                return
+            include_all = params.get("all") in ("1", "true")
+            # Lazy-signing checkpointers pay pure-Python G2 work building
+            # the payload — that belongs on the pool, not the IO thread.
+            conn.inflight = True
+            self._pool.submit(self._build_checkpoints, conn, epoch, include_all)
+            return
         if path != "/proof":
             self._respond(conn, 404, {"error": "not found", "path": path})
             return
@@ -493,6 +534,38 @@ class ProofApiServer:
             code, payload = 416, {"error": str(err)}
         except Exception as err:  # noqa: BLE001 - a client must get an
             # answer, and the IO loop must never die for one request
+            code, payload = 500, {"error": repr(err)}
+        self._done.append((conn, code, payload))
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+
+    def _build_checkpoints(
+        self, conn: _Conn, epoch: Optional[int], include_all: bool
+    ) -> None:
+        """Worker-pool side of ``GET /checkpoints`` (ISSUE 20)."""
+        try:
+            from ..lightsync.checkpoint import CheckpointError
+        except Exception:  # pragma: no cover - lightsync always present
+            CheckpointError = ValueError  # noqa: N806
+        try:
+            with trace.span(
+                "node.checkpoint_api",
+                epoch=-1 if epoch is None else epoch,
+                all=int(include_all),
+            ):
+                payload = self._checkpoints_fn(
+                    target_epoch=epoch, include_all=include_all
+                )
+            payload = dict(payload)
+            payload["head"] = self._head_fn()
+            code = 200
+            self._count("checkpoints_served")
+        except CheckpointError as err:
+            code, payload = 416, {"error": str(err)}
+        except Exception as err:  # noqa: BLE001 - same contract as proofs:
+            # the client gets an answer, the IO loop never dies for one
             code, payload = 500, {"error": repr(err)}
         self._done.append((conn, code, payload))
         try:

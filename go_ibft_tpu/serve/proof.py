@@ -33,13 +33,18 @@ Trust posture (docs/SERVING.md): the client trusts its checkpoint — a
 is re-derived: each height's quorum is re-checked against the set
 obtained by applying the served diffs hop by hop from the checkpoint, so
 a proof spliced across a substantive rotation with the STALE set fails
-quorum at the first post-rotation height.  That catches omission and
-staleness, not fabrication: the diffs themselves carry no signature, so
-a malicious server can invent a rotation to its own keys — as with
-block-sync (``chain/sync.py``), seals cover only ``(raw_proposal,
-round)``, and binding the NEXT set (like the height) into the block
-content is the embedder's proposal-content check.  The two seams are
-documented together in docs/SERVING.md's trust assumptions.
+quorum at the first post-rotation height.  The walk alone catches
+omission and staleness; FABRICATION is closed by next-set content
+commitments (ISSUE 20, ``go_ibft_tpu/lightsync/commitment.py``): a
+producing embedder embeds the NEXT height's set root inside the signed
+proposal bytes, and :func:`walk_sets` checks every hop's derived set
+against the root the PREVIOUS height's quorum sealed — a server-invented
+rotation (or an omitted one) now fails at the commitment check, no
+old-quorum signature over the diff required.  Enforcement is opt-in per
+verifier (``require_commitments=True``) because commitment-free chains
+predate the scheme; when enforced, a hop without a commitment is itself
+an error.  The remaining epoch-boundary assumptions are documented in
+docs/SERVING.md's trust assumptions.
 """
 
 from __future__ import annotations
@@ -49,6 +54,7 @@ from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
 from ..chain.sync import SyncSource
 from ..chain.wal import FinalizedBlock
+from ..lightsync.commitment import extract_next_set, set_root
 from ..messages.helpers import CommittedSeal
 from ..messages.wire import Proposal
 
@@ -278,6 +284,8 @@ def _check_powers(powers: Mapping[bytes, int], height: int) -> None:
 def walk_sets(
     trusted_powers: Mapping[bytes, int],
     proof: FinalityProof,
+    *,
+    require_commitments: bool = False,
 ) -> Dict[int, Mapping[bytes, int]]:
     """Structurally validate ``proof`` and derive each height's validator
     set by walking the diff chain from the trusted checkpoint powers.
@@ -288,8 +296,18 @@ def walk_sets(
     trusted powers already apply there — a server cannot substitute the
     anchor set), or any hop whose powers are not strictly positive ints
     (a non-positive total would make ``calculate_quorum`` vacuous).
+
+    Next-set commitment enforcement (ISSUE 20): when height ``h-1``'s
+    proposal carries a next-set commitment frame
+    (``lightsync/commitment.py``), the set derived for ``h`` must match
+    the committed root — a fabricated diff AND an omitted rotation both
+    fail here, because the root was sealed by ``h-1``'s commit quorum
+    inside the proposal bytes.  With ``require_commitments=True`` a hop
+    whose predecessor carries NO commitment is rejected too (the posture
+    for chains producing commitments end to end); the first proven
+    height needs none — the trusted anchor powers apply there.
     Cryptographic checks are the verifier's (``serve/server.py``); this
-    walk is pure dict arithmetic.
+    walk is pure dict arithmetic plus one keccak per set change.
     """
     if not proof.entries:
         raise ProofError("finality proof carries no heights")
@@ -321,14 +339,36 @@ def walk_sets(
     if not cur:
         raise ProofError("trusted checkpoint powers are empty")
     _check_powers(cur, first)
-    for h in heights:
+    cur_root: Optional[bytes] = None  # set_root(cur), computed on demand
+    prev_entry: Optional[ProofEntry] = None
+    for entry in proof.entries:
+        h = entry.height
         d = diff_by_height.get(h)
         if d is not None:
             cur = d.apply(cur)
             if not cur:
                 raise ProofError(f"set diff at height {h} empties the set")
             _check_powers(cur, h)
+            cur_root = None
+        if prev_entry is not None:
+            committed = extract_next_set(prev_entry.proposal.raw_proposal)
+            if committed is None:
+                if require_commitments:
+                    raise ProofError(
+                        f"height {h}: the height {h - 1} proposal carries "
+                        "no next-set commitment (required by this client)"
+                    )
+            else:
+                if cur_root is None:
+                    cur_root = set_root(cur)
+                if committed != cur_root:
+                    raise ProofError(
+                        f"height {h}: served validator set does not match "
+                        f"the next-set root the height {h - 1} quorum "
+                        "sealed (fabricated or omitted rotation)"
+                    )
         sets[h] = cur
+        prev_entry = entry
     return sets
 
 

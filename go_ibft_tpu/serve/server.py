@@ -221,7 +221,14 @@ class ProofVerifier:
         bls_keys_for_height: Optional[Callable[[int], Mapping]] = None,
         sig_cache: Optional[SigVerdictCache] = None,
         tenant_id: Optional[str] = None,
+        require_commitments: bool = False,
     ):
+        # ISSUE 20: enforce next-set content commitments per diff hop
+        # (lightsync/commitment.py).  Off by default — proofs from
+        # commitment-free chains predate the scheme and must keep
+        # verifying; a client of a commitment-producing chain turns it
+        # on and fabricated/omitted rotations die in walk_sets.
+        self._require_commitments = require_commitments
         self._sched = None
         self._tenant_id = None
         if lane_verifier is not None:
@@ -263,7 +270,11 @@ class ProofVerifier:
         on rejection.  Thread-safe — concurrent calls share the sig-
         verdict cache and (with a scheduler) coalesce their fresh drains.
         """
-        sets = walk_sets(trusted_powers, proof)
+        sets = walk_sets(
+            trusted_powers,
+            proof,
+            require_commitments=self._require_commitments,
+        )
         lanes: List[Tuple[bytes, object]] = []
         cert_entries: List[ProofEntry] = []
         for entry in proof.entries:

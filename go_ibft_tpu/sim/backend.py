@@ -18,7 +18,7 @@ verifier instead (tests/test_cluster_sim.py).
 from __future__ import annotations
 
 import hashlib
-from typing import List, Optional, Sequence
+from typing import Callable, List, Mapping, Optional, Sequence
 
 from ..messages import (
     CommitMessage,
@@ -50,13 +50,35 @@ def sim_hash(raw_proposal: bytes) -> bytes:
 
 
 class SimBackend:
-    """Backend + MessageConstructor + Verifier for one sim node."""
+    """Backend + MessageConstructor + Verifier for one sim node.
 
-    def __init__(self, index: int, addresses: Sequence[bytes]) -> None:
+    ``commit_next_set`` (ISSUE 20, default off) makes every proposal carry
+    a next-set commitment suffix (:mod:`go_ibft_tpu.lightsync.commitment`)
+    over the NEXT height's validator set, and makes ``is_valid_proposal``
+    require + check it against ``validators_for_height`` — the sim-side
+    producer/enforcer pair for commitment-enforced proofs.  Off by
+    default so the byte-identity oracles (chain-identity, chaos replay)
+    keep their exact historical bytes.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        addresses: Sequence[bytes],
+        *,
+        commit_next_set: bool = False,
+        validators_for_height: Optional[
+            Callable[[int], Mapping[bytes, int]]
+        ] = None,
+    ) -> None:
         self.index = index
         self.addresses = list(addresses)
         self.address = self.addresses[index]
         self._members = frozenset(self.addresses)
+        self.commit_next_set = commit_next_set
+        self._validators_for_height = validators_for_height or (
+            lambda _height: {a: 1 for a in self.addresses}
+        )
         self.inserted: List[tuple] = []
 
     # -- MessageConstructor ---------------------------------------------
@@ -112,7 +134,24 @@ class SimBackend:
     # -- Verifier -------------------------------------------------------
 
     def is_valid_proposal(self, raw_proposal: bytes) -> bool:
-        return raw_proposal.startswith(b"sim-block-")
+        if not raw_proposal.startswith(b"sim-block-"):
+            return False
+        if not self.commit_next_set:
+            return True
+        # Commitment-enforced mode: the proposal must carry a next-set
+        # commitment and it must match the set the proposer was obliged
+        # to commit to (the height is parseable from the sim prefix, so
+        # the sim seam can check the EXACT root, not just presence).
+        from ..lightsync.commitment import extract_next_set, set_root, strip_next_set
+
+        committed = extract_next_set(raw_proposal)
+        if committed is None:
+            return False
+        try:
+            height = int(strip_next_set(raw_proposal)[len(b"sim-block-"):])
+        except ValueError:
+            return False
+        return committed == set_root(self._validators_for_height(height + 1))
 
     def is_valid_validator(self, msg: IbftMessage) -> bool:
         return msg.sender in self._members
@@ -132,12 +171,19 @@ class SimBackend:
     # -- ValidatorBackend -----------------------------------------------
 
     def get_voting_powers(self, height: int) -> dict:
-        return {a: 1 for a in self.addresses}
+        return dict(self._validators_for_height(height))
 
     # -- Backend --------------------------------------------------------
 
     def build_proposal(self, view: View) -> bytes:
-        return sim_block(view.height)
+        raw = sim_block(view.height)
+        if self.commit_next_set:
+            from ..lightsync.commitment import embed_next_set, set_root
+
+            raw = embed_next_set(
+                raw, set_root(self._validators_for_height(view.height + 1))
+            )
+        return raw
 
     def insert_proposal(self, proposal: Proposal, committed_seals) -> None:
         self.inserted.append((proposal, list(committed_seals)))

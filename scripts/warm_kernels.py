@@ -423,6 +423,25 @@ def main() -> int:
         assert multi_aggregate_check(lanes, route="device").all()
         _stamp("batched multi-pairing (2-lane bucket)", t0)
 
+        # Checkpoint skip-chain verify shape (ISSUE 20): a cold sync
+        # verifies ~log2(epochs) certificate lanes in ONE dispatch —
+        # warm the 4-lane bucket so the first CheckpointClient on a
+        # device route never compiles mid-sync.
+        t0 = time.perf_counter()
+        ck_lanes = [
+            (
+                b"warm ckpt lane %03d" % i + b"\x00" * 13,
+                [_hbls.aggregate_signatures(
+                    [k.sign(b"warm ckpt lane %03d" % i + b"\x00" * 13)
+                     for k in wkeys]
+                )],
+                [k.pubkey for k in wkeys],
+            )
+            for i in range(4)
+        ]
+        assert multi_aggregate_check(ck_lanes, route="device").all()
+        _stamp("checkpoint skip-chain multi-pairing (4-lane bucket)", t0)
+
     return _finish(cold)
 
 

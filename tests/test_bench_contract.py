@@ -848,3 +848,49 @@ def test_fleet_schedule_membership_and_schema():
         spec = gates.DEFAULT_SLO_TABLE[key]
         assert spec.warn == 0 and spec.fail == 0
     assert gates.DEFAULT_SLO_TABLE["fleet_proof_p99_ms"].fail is not None
+
+
+def test_checkpoint_only_flag_scopes_evidence_contract():
+    """`bench.py --checkpoint-only` (the make checkpoint-smoke entry)
+    runs ONLY config #18 and scopes the rc=0 evidence contract to it —
+    static check on _run, like the other --*-only pins."""
+    tree = ast.parse(pathlib.Path(bench.__file__).read_text())
+    run_fn = next(
+        n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name == "_run"
+    )
+    src = ast.unparse(run_fn)
+    assert "checkpoint_only" in src
+    assert "config18_checkpoint_sync" in src
+
+
+def test_checkpoint_schedule_membership_and_schema():
+    """Config #18's driver contract (ISSUE 20): it sits in BOTH
+    schedules, owns the checkpoint_sync_1m metric key, measures the
+    O(log n) cold sync against the linear baseline with a real-crypto
+    rotation + wire-path splice attack, and gates the dispatch-count
+    and bytes-ratio SLOs BEFORE publishing the evidence line."""
+    import inspect
+
+    for schedule in (bench._FALLBACK_SCHEDULE, bench._DEVICE_SCHEDULE):
+        assert any(
+            fn.__name__ == "config18_checkpoint_sync" for fn, _ in schedule
+        ), "config18 missing from a driver schedule"
+    assert bench.config18_checkpoint_sync.metric == "checkpoint_sync_1m"
+    src = inspect.getsource(bench.config18_checkpoint_sync)
+    for needle in (
+        "cold_sync",
+        "skip_path",
+        "lazy_sign",
+        "embed_next_set",
+        "require_commitments",
+        "splice",
+        "next-set root",
+        "pairing_dispatches",
+        "checkpoint_sync_dispatches",
+        "checkpoint_real_sync_dispatches",
+        "checkpoint_bytes_fraction_of_linear",
+        "gate_slo_records",
+    ):
+        assert needle in src, f"config18 lost its {needle} step"
+    # the SLO gate precedes the evidence line
+    assert src.index("gate_slo_records") < src.index("_log(")

@@ -1,0 +1,670 @@
+"""Long-chain light clients (ISSUE 20): commitments, checkpoints, sync.
+
+Pins the lightsync acceptance surface:
+
+* next-set commitments: the canonical ``set_root``, the magic-framed
+  proposal suffix, and ``walk_sets`` enforcement — a fabricated rotation
+  diff and an omitted rotation both die at the commitment check, and
+  ``require_commitments`` fails closed on commitment-less chains;
+* the epoch skip structure: O(log n) paths, power-of-2 hops, body-only
+  digests so lazy signing never invalidates chained records;
+* adversarial checkpoint certificates: forged, relabeled, quorum-power-
+  short, and out-of-set bitmaps are all rejected BEFORE any pairing
+  (the multipair dispatch counter does not move), a forged chain head
+  dies in the one batched pairing, and a skip link across a real
+  rotation fails closed without a bridge;
+* dispatch pins + oracle parity: a whole skip chain verifies in ONE
+  ``multi_aggregate_check`` dispatch whose per-lane verdicts are
+  bit-identical to the sequential ``aggregate_check`` oracle (corrupt
+  lanes included);
+* durability: checkpoint records replay from the WAL (torn tails across
+  an epoch boundary recover cleanly and the lost boundary rebuilds),
+  and ``ChainRunner.recover`` restores a checkpointer that serves
+  without re-signing history;
+* the wire path: ``GET /checkpoints`` end to end — HTTP cold sync
+  anchors across a validator rotation with a commitment-enforced bridge
+  proof, and the spliced-diff attack is rejected on the same bytes a
+  real client fetches.
+"""
+
+import json
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from go_ibft_tpu.chain import ChainRunner, WriteAheadLog
+from go_ibft_tpu.chain.wal import FinalizedBlock
+from go_ibft_tpu.core import IBFT
+from go_ibft_tpu.crypto import PrivateKey
+from go_ibft_tpu.crypto import ecdsa as ec
+from go_ibft_tpu.crypto.backend import encode_signature, proposal_hash_of
+from go_ibft_tpu.crypto.bls import BLSPrivateKey
+from go_ibft_tpu.crypto.keccak import keccak256
+from go_ibft_tpu.lightsync import (
+    COMMIT_SUFFIX_BYTES,
+    CheckpointClient,
+    CheckpointError,
+    CheckpointRecord,
+    CheckpointVerifier,
+    Checkpointer,
+    embed_next_set,
+    extract_next_set,
+    http_fetcher,
+    set_root,
+    skip_epochs,
+    skip_path,
+    strip_next_set,
+)
+from go_ibft_tpu.messages.helpers import CommittedSeal
+from go_ibft_tpu.messages.wire import Proposal
+from go_ibft_tpu.node.proof_api import ProofApiServer
+from go_ibft_tpu.serve import (
+    FinalityProof,
+    ProofBuilder,
+    ProofCache,
+    ProofEntry,
+    ProofError,
+    ProofServer,
+    ProofVerifier,
+    SetDiff,
+    walk_sets,
+)
+from go_ibft_tpu.utils import metrics
+from go_ibft_tpu.verify.aggregate import (
+    MULTIPAIR_DISPATCHES_KEY,
+    multi_aggregate_check,
+)
+from go_ibft_tpu.verify.bls import aggregate_check
+
+from harness import MockBackend, NullLogger
+
+# -- fixtures ----------------------------------------------------------------
+#
+# One 5-key pool; set A = keys 0..3, set B = keys 1..4, rotation takes
+# effect at ROTATE_AT (mid-epoch — walk_sets cannot express a rotation
+# on the first proven height, so checkpoint bridges need the diff to
+# land strictly inside the bridged range).  Pure-Python signing is the
+# dominant cost (~90 ms per ECDSA seal, ~40 ms per BLS share), so the
+# signed chains are module-scoped and must never be mutated in place.
+
+_KEYS = [PrivateKey.from_seed(b"lightsync-%d" % i) for i in range(5)]
+_SET_A = _KEYS[:4]
+_SET_B = _KEYS[1:5]
+_BY_ADDR = {k.address: k for k in _KEYS}
+_BLS = {
+    k.address: BLSPrivateKey.from_seed(b"lightsync-bls-%d" % i)
+    for i, k in enumerate(_KEYS)
+}
+ROTATE_AT = 10
+HEIGHTS = 16
+SPACING = 4
+
+
+def _powers(keys):
+    return {k.address: 1 for k in keys}
+
+
+_STATIC_POWERS = _powers(_SET_A)
+
+
+def _validators(height):
+    return _powers(_SET_B if height >= ROTATE_AT else _SET_A)
+
+
+def _bls_pubkeys(_height):
+    return {addr: key.pubkey for addr, key in _BLS.items()}
+
+
+def _dispatches():
+    return metrics.get_counter(MULTIPAIR_DISPATCHES_KEY)
+
+
+def _committed_block(height):
+    """A finalized block whose content commits the NEXT height's set and
+    whose seals come from a quorum (3 of 4) of the height's own set."""
+    raw = embed_next_set(
+        b"ls block %d" % height, set_root(_validators(height + 1))
+    )
+    proposal = Proposal(raw_proposal=raw, round=0)
+    phash = proposal_hash_of(proposal)
+    seals = [
+        CommittedSeal(
+            signer=addr,
+            signature=encode_signature(*ec.sign(_BY_ADDR[addr], phash)),
+        )
+        for addr in sorted(_validators(height))[:3]
+    ]
+    return FinalizedBlock(height, proposal, seals)
+
+
+class _ListSource:
+    """Static SyncSource over a prebuilt chain."""
+
+    def __init__(self, blocks):
+        self.blocks = blocks
+
+    def latest_height(self):
+        return self.blocks[-1].height if self.blocks else 0
+
+    def get_blocks(self, start, end):
+        return [b for b in self.blocks if start <= b.height <= end]
+
+
+@pytest.fixture(scope="module")
+def rot_chain():
+    return [_committed_block(h) for h in range(1, HEIGHTS + 1)]
+
+
+@pytest.fixture(scope="module")
+def rot_ckpt(rot_chain):
+    ck = Checkpointer(SPACING, _validators, signers=_BLS)
+    for block in rot_chain:
+        ck.on_finalize(block.height, proposal_hash_of(block.proposal))
+    return ck
+
+
+@pytest.fixture(scope="module")
+def static_ckpt():
+    """Four epochs over a static set (spacing 2, heights 2..8), signed
+    eagerly — the adversarial tests doctor DECODED copies of these."""
+    ck = Checkpointer(2, lambda _h: _STATIC_POWERS, signers=_BLS)
+    for h in range(1, 9):
+        ck.on_finalize(h, keccak256(b"ls static blk %d" % h))
+    return ck
+
+
+def _decoded(payload):
+    return [CheckpointRecord.decode(bytes.fromhex(r)) for r in payload["checkpoints"]]
+
+
+# -- next-set commitments ----------------------------------------------------
+
+
+def test_commitment_frame_round_trip():
+    root = set_root(_STATIC_POWERS)
+    raw = embed_next_set(b"payload", root)
+    assert len(raw) == len(b"payload") + COMMIT_SUFFIX_BYTES
+    assert extract_next_set(raw) == root
+    assert strip_next_set(raw) == b"payload"
+    # absent frame: extract says so, strip is the identity
+    assert extract_next_set(b"payload") is None
+    assert strip_next_set(b"payload") == b"payload"
+    with pytest.raises(ValueError, match="already carries"):
+        embed_next_set(raw, root)
+    with pytest.raises(ValueError, match="32 bytes"):
+        embed_next_set(b"payload", b"short")
+
+
+def test_set_root_canonical_and_binding():
+    assert set_root({b"x": 1, b"y": 2}) == set_root({b"y": 2, b"x": 1})
+    # a power change is a rotation too (it moves every quorum threshold)
+    assert set_root({b"x": 1, b"y": 2}) != set_root({b"x": 1, b"y": 3})
+    assert set_root({b"x": 1, b"y": 2}) != set_root({b"x": 1})
+    with pytest.raises(ValueError, match="non-positive"):
+        set_root({b"x": 0})
+
+
+def test_skip_structure_is_logarithmic_and_linked():
+    assert skip_path(1) == [1]
+    assert skip_epochs(1) == []
+    assert skip_path(13) == [1, 5, 13]
+    assert len(skip_path(1000)) == 9
+    assert len(skip_path(1 << 20)) == 21  # a million epochs: 21 hops
+    for epoch in (2, 3, 7, 64, 1000):
+        path = skip_path(epoch)
+        assert path[0] == 1 and path[-1] == epoch
+        for lo, hi in zip(path, path[1:]):
+            gap = hi - lo
+            assert gap > 0 and gap & (gap - 1) == 0
+            # every hop gap is a skip slot the record actually carries
+            assert gap.bit_length() - 1 in skip_epochs(hi)
+    with pytest.raises(ValueError):
+        skip_path(0)
+
+
+# -- walk_sets enforcement (pure structure: no real seals needed) ------------
+
+
+def _entry(height, *, commit_to=None):
+    raw = b"ls walk blk %d" % height
+    if commit_to is not None:
+        raw = embed_next_set(raw, set_root(commit_to))
+    return ProofEntry(height=height, proposal=Proposal(raw_proposal=raw, round=0))
+
+
+def test_walk_sets_commitment_blocks_fabricated_and_omitted_diffs():
+    a, b = _powers(_SET_A), _powers(_SET_B)
+    entries = [
+        _entry(h, commit_to=(b if h + 1 >= ROTATE_AT else a))
+        for h in range(9, 13)
+    ]
+    rotation = SetDiff(
+        height=ROTATE_AT,
+        added={_KEYS[4].address: 1},
+        removed=(_KEYS[0].address,),
+    )
+    honest = FinalityProof(checkpoint_height=8, entries=entries, diffs=[rotation])
+    assert walk_sets(a, honest, require_commitments=True)[12] == b
+    # fabricated: the server invents a rotation no quorum ever sealed
+    evil = FinalityProof(
+        checkpoint_height=8,
+        entries=entries,
+        diffs=[rotation, SetDiff(height=12, added={b"\xab" * 20: 1000})],
+    )
+    with pytest.raises(ProofError, match="next-set root"):
+        walk_sets(a, evil, require_commitments=True)
+    # omitted: the server hides the real rotation
+    hidden = FinalityProof(checkpoint_height=8, entries=entries, diffs=[])
+    with pytest.raises(ProofError, match="next-set root"):
+        walk_sets(a, hidden, require_commitments=True)
+
+
+def test_walk_sets_require_commitments_gates_legacy_chains():
+    a = _powers(_SET_A)
+    legacy = FinalityProof(
+        checkpoint_height=8, entries=[_entry(h) for h in range(9, 12)]
+    )
+    # back-compat default: commitment-less chains still verify...
+    assert walk_sets(a, legacy)[11] == a
+    # ...but an enforcing client fails closed, never open
+    with pytest.raises(ProofError, match="next-set commitment"):
+        walk_sets(a, legacy, require_commitments=True)
+
+
+# -- checkpoint record codec -------------------------------------------------
+
+
+def test_checkpoint_record_codec_round_trip(static_ckpt):
+    rec = static_ckpt.record(4)
+    assert rec.signed and len(rec.skip_digests) == len(skip_epochs(4))
+    assert CheckpointRecord.decode(rec.encode()) == rec
+    unsigned = replace(rec, agg_seal=b"", bitmap=b"")
+    assert CheckpointRecord.decode(unsigned.encode()) == unsigned
+    assert not unsigned.signed
+    # digest is body-only: signing later never moves the skip links
+    assert unsigned.digest() == rec.digest()
+
+
+def test_checkpoint_record_decode_rejects_malformed(static_ckpt):
+    blob = static_ckpt.record(1).encode()
+    with pytest.raises(ValueError, match="version"):
+        CheckpointRecord.decode(bytes([blob[0] ^ 1]) + blob[1:])
+    with pytest.raises(ValueError, match="too short"):
+        CheckpointRecord.decode(blob[:10])
+    with pytest.raises(ValueError, match="length mismatch"):
+        CheckpointRecord.decode(blob + b"\x00")
+    # seal-length field (header bytes 20:22) must be 0 or BLS_SEAL_BYTES
+    with pytest.raises(ValueError, match="seal length"):
+        CheckpointRecord.decode(blob[:20] + (191).to_bytes(2, "big") + blob[22:])
+
+
+# -- Checkpointer ------------------------------------------------------------
+
+
+def test_checkpointer_boundaries_idempotence_and_links():
+    ck = Checkpointer(4, lambda _h: _STATIC_POWERS)  # unsigned bodies
+    assert ck.on_finalize(3, b"\x11" * 32) is None
+    rec1 = ck.on_finalize(4, b"\x11" * 32)
+    assert (rec1.epoch, rec1.height, rec1.skip_digests) == (1, 4, ())
+    # recovery replay may re-deliver a boundary: first write wins
+    assert ck.on_finalize(4, b"\x22" * 32) is None
+    assert ck.record(1).chain_commitment == b"\x11" * 32
+    rec2 = ck.on_finalize(8, b"\x33" * 32)
+    assert rec2.skip_digests == (rec1.digest(),)
+    # a gap in the chain can never be papered over silently
+    with pytest.raises(CheckpointError, match="missing prior"):
+        Checkpointer(4, lambda _h: _STATIC_POWERS).on_finalize(8, b"\x33" * 32)
+
+
+def test_lazy_signing_pays_only_the_served_path():
+    ck = Checkpointer(
+        1, lambda _h: _STATIC_POWERS, signers=_BLS, lazy_sign=True
+    )
+    for e in range(1, 33):
+        ck.on_finalize(e, keccak256(b"lazy %d" % e))
+    assert ck.latest_epoch == 32
+    assert not any(ck.record(e).signed for e in range(1, 33))
+    payload = ck.wire_payload()
+    served = _decoded(payload)
+    assert [r.epoch for r in served] == skip_path(32)
+    assert all(r.signed for r in served)
+    # 32 epochs, O(log n) signatures: only the skip path ever signs
+    assert [e for e in range(1, 33) if ck.record(e).signed] == skip_path(32)
+    sub = _decoded(ck.wire_payload(target_epoch=5))
+    assert [r.epoch for r in sub] == skip_path(5)
+    with pytest.raises(CheckpointError, match="outside"):
+        ck.wire_payload(target_epoch=33)
+    empty = Checkpointer(4, lambda _h: _STATIC_POWERS).wire_payload()
+    assert empty["latest_epoch"] == 0 and empty["checkpoints"] == []
+
+
+# -- CheckpointVerifier: dispatch pins, oracle parity, adversaries -----------
+
+
+def test_verify_chain_is_one_dispatch_and_anchors(static_ckpt):
+    before = _dispatches()
+    anchor = CheckpointVerifier(_bls_pubkeys).verify_chain(
+        static_ckpt.wire_payload(), _STATIC_POWERS
+    )
+    assert _dispatches() - before == 1  # the whole skip chain: ONE pairing
+    assert (anchor.height, anchor.epoch, anchor.spacing) == (8, 4, 2)
+    assert anchor.powers == _STATIC_POWERS
+    assert anchor.lanes == len(skip_path(4)) == 3
+
+
+def test_structural_million_height_sync_is_one_dispatch():
+    """The 1M-height structural pin (satellite d): 1000 epochs of 1000
+    heights, lazy-signed, serve and verify the whole genesis -> head
+    skip chain — 9 records, O(log n) signatures, ONE batched pairing."""
+    ck = Checkpointer(
+        1000, lambda _h: _STATIC_POWERS, signers=_BLS, lazy_sign=True
+    )
+    for e in range(1, 1001):  # only boundaries finalize checkpoints
+        ck.on_finalize(e * 1000, keccak256(b"1m blk %d" % e))
+    payload = ck.wire_payload()
+    assert len(payload["checkpoints"]) == len(skip_path(1000)) == 9
+    before = _dispatches()
+    anchor = CheckpointVerifier(_bls_pubkeys).verify_chain(
+        payload, _STATIC_POWERS
+    )
+    assert _dispatches() - before == 1
+    assert anchor.height == 1_000_000 and anchor.epoch == 1000
+
+
+def test_linear_payload_verifies_with_same_verifier(static_ckpt):
+    """``all=1`` serves consecutive epochs — gap ``2**0`` hops, so the
+    one verifier consumes both shapes (the measured-baseline contract)."""
+    payload = static_ckpt.wire_payload(include_all=True)
+    assert len(payload["checkpoints"]) == 4
+    anchor = CheckpointVerifier(_bls_pubkeys).verify_chain(
+        payload, _STATIC_POWERS
+    )
+    assert anchor.lanes == 4 and anchor.epoch == 4
+
+
+def test_multipair_verdicts_match_sequential_oracle(static_ckpt):
+    lanes, _records, _anchor = CheckpointVerifier(_bls_pubkeys).build_lanes(
+        static_ckpt.wire_payload(include_all=True), _STATIC_POWERS
+    )
+    # corrupt one lane: an honest seal over a message nobody signed
+    msg, points, pubkeys = lanes[2]
+    lanes = lanes[:2] + [(keccak256(b"not the digest"), points, pubkeys)] + lanes[3:]
+    batched = np.asarray(multi_aggregate_check(lanes, route="host"), dtype=bool)
+    oracle = np.asarray(
+        [aggregate_check(m, pts, pks) for m, pts, pks in lanes], dtype=bool
+    )
+    assert batched.tolist() == oracle.tolist() == [True, True, False, True]
+
+
+def test_short_power_bitmap_rejected_before_any_pairing(static_ckpt):
+    payload = static_ckpt.wire_payload()
+    records = _decoded(payload)
+    # 2 of 4 signers < quorum 3; the digest is body-only so the doctored
+    # record still CHAINS — it must die at the exact-int power gate
+    weak = replace(records[-1], bitmap=bytes([0b0011]))
+    doctored = dict(
+        payload, checkpoints=payload["checkpoints"][:-1] + [weak.encode().hex()]
+    )
+    before = _dispatches()
+    with pytest.raises(CheckpointError, match="below quorum"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(doctored, _STATIC_POWERS)
+    assert _dispatches() == before  # zero pairings spent on the forgery
+
+
+def test_bitmap_bit_outside_set_rejected_before_pairing(static_ckpt):
+    payload = static_ckpt.wire_payload()
+    records = _decoded(payload)
+    weak = replace(records[-1], bitmap=bytes([0b10111]))  # bit 4, 4-validator set
+    doctored = dict(
+        payload, checkpoints=payload["checkpoints"][:-1] + [weak.encode().hex()]
+    )
+    before = _dispatches()
+    with pytest.raises(CheckpointError, match="outside"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(doctored, _STATIC_POWERS)
+    assert _dispatches() == before
+
+
+def test_unregistered_signer_rejected_before_pairing(static_ckpt):
+    before = _dispatches()
+    with pytest.raises(CheckpointError, match="no registered BLS key"):
+        CheckpointVerifier(lambda _h: {}).verify_chain(
+            static_ckpt.wire_payload(), _STATIC_POWERS
+        )
+    assert _dispatches() == before
+
+
+def test_relabeled_records_rejected_before_pairing(static_ckpt):
+    payload = static_ckpt.wire_payload()  # epochs [1, 2, 4]
+    records = _decoded(payload)
+    # replay the epoch-2 record in the epoch-4 slot: the path degenerates
+    before = _dispatches()
+    replayed = dict(
+        payload,
+        checkpoints=payload["checkpoints"][:-1] + [payload["checkpoints"][1]],
+    )
+    with pytest.raises(CheckpointError, match="power-of-2"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(replayed, _STATIC_POWERS)
+    # relabel the head to a different height: epoch * spacing pins it
+    mislabeled = replace(records[-1], height=records[-1].height - 2)
+    doctored = dict(
+        payload,
+        checkpoints=payload["checkpoints"][:-1] + [mislabeled.encode().hex()],
+    )
+    with pytest.raises(CheckpointError, match="height"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(doctored, _STATIC_POWERS)
+    assert _dispatches() == before
+
+
+def test_forged_chain_head_dies_in_the_pairing(static_ckpt):
+    """Re-pointing the head at a forked chain changes the digest; the
+    honest quorum's seal no longer covers it, so the ONE batched pairing
+    rejects the lane — forgery costs the adversary a quorum of keys."""
+    payload = static_ckpt.wire_payload()
+    records = _decoded(payload)
+    forged = replace(records[-1], chain_commitment=keccak256(b"forked chain"))
+    doctored = dict(
+        payload, checkpoints=payload["checkpoints"][:-1] + [forged.encode().hex()]
+    )
+    before = _dispatches()
+    with pytest.raises(CheckpointError, match="pairing"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(doctored, _STATIC_POWERS)
+    assert _dispatches() - before == 1
+
+
+def test_skip_over_rotation_fails_closed_without_bridge(rot_ckpt):
+    """A skip path whose head commits a rotated set can never silently
+    anchor a client still trusting the old set."""
+    before = _dispatches()
+    with pytest.raises(CheckpointError, match="no bridge"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(
+            rot_ckpt.wire_payload(), _powers(_SET_A)
+        )
+    assert _dispatches() == before
+
+
+def test_bridge_resolves_rotation_and_lying_bridge_rejected(rot_ckpt):
+    calls = []
+
+    def bridge(from_h, to_h, _powers_in):
+        calls.append((from_h, to_h))
+        return _powers(_SET_B)
+
+    anchor = CheckpointVerifier(_bls_pubkeys).verify_chain(
+        rot_ckpt.wire_payload(), _powers(_SET_A), bridge=bridge
+    )
+    # skip path [1, 2, 4]: only the 8 -> 16 hop crosses the rotation
+    assert calls == [(8, 16)]
+    assert anchor.height == 16 and anchor.powers == _powers(_SET_B)
+    # a bridge that lies about the new set cannot satisfy the root the
+    # old quorum sealed into the record
+    with pytest.raises(CheckpointError, match="committed set root"):
+        CheckpointVerifier(_bls_pubkeys).verify_chain(
+            rot_ckpt.wire_payload(),
+            _powers(_SET_A),
+            bridge=lambda *_a: {b"evil-validator-addr": 4},
+        )
+
+
+# -- durability: WAL + runner recovery ---------------------------------------
+
+
+def test_wal_checkpoint_records_replay_and_restore(tmp_path, static_ckpt):
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    for e in range(1, 5):
+        wal.append_checkpoint(static_ckpt.record(e))
+        wal.append_checkpoint(static_ckpt.record(e))  # re-append: first wins
+    state = WriteAheadLog(wal.path).replay()
+    assert [r.epoch for r in state.checkpoints] == [1, 2, 3, 4]
+    assert [r.encode() for r in state.checkpoints] == [
+        static_ckpt.record(e).encode() for e in range(1, 5)
+    ]
+    # a restarted node adopts the durable records and serves WITHOUT
+    # re-signing: this checkpointer holds no signing keys at all
+    restarted = Checkpointer(2, lambda _h: _STATIC_POWERS)
+    restarted.restore(state.checkpoints)
+    assert restarted.latest_epoch == 4
+    anchor = CheckpointVerifier(_bls_pubkeys).verify_chain(
+        restarted.wire_payload(), _STATIC_POWERS
+    )
+    assert anchor.epoch == 4
+
+
+def test_wal_torn_checkpoint_tail_recovers_and_rebuilds(tmp_path):
+    ck = Checkpointer(2, lambda _h: _STATIC_POWERS, signers=_BLS, lazy_sign=True)
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    for h in range(1, 5):
+        wal.append_finalize(h, Proposal(raw_proposal=b"t%d" % h, round=0), [])
+        rec = ck.on_finalize(h, keccak256(b"t%d" % h))
+        if rec is not None:
+            wal.append_checkpoint(rec)
+    wal.close()
+    with open(wal.path, "ab") as fh:  # crash mid-append at the next boundary
+        fh.write(b'{"kind":"checkpoint","epoch":3,"rec":"01')
+    state = WriteAheadLog(wal.path).replay()
+    assert state.dropped_tail
+    assert [b.height for b in state.blocks] == [1, 2, 3, 4]
+    assert [r.epoch for r in state.checkpoints] == [1, 2]
+    # the lost boundary rebuilds cleanly: the skip links it needs
+    # (epochs 2 and 1) survived the tear
+    restarted = Checkpointer(
+        2, lambda _h: _STATIC_POWERS, signers=_BLS, lazy_sign=True
+    )
+    restarted.restore(state.checkpoints)
+    rebuilt = restarted.on_finalize(6, keccak256(b"t6"))
+    assert rebuilt is not None and rebuilt.epoch == 3
+    assert rebuilt.skip_digests == (
+        state.checkpoints[1].digest(),
+        state.checkpoints[0].digest(),
+    )
+
+
+class _NullTransport:
+    def multicast(self, message):
+        pass
+
+
+def test_runner_recover_restores_checkpointer(tmp_path, static_ckpt):
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    for h in range(1, 5):
+        wal.append_finalize(h, Proposal(raw_proposal=b"r%d" % h, round=0), [])
+    for e in (1, 2):
+        wal.append_checkpoint(static_ckpt.record(e))
+    wal.close()
+    backend = MockBackend(b"node-0")
+    backend.voting_powers = {b"node-%d" % i: 1 for i in range(4)}
+    engine = IBFT(NullLogger(), backend, _NullTransport())
+    ck = Checkpointer(2, lambda _h: _STATIC_POWERS)
+    runner = ChainRunner(engine, WriteAheadLog(wal.path), checkpointer=ck)
+    try:
+        assert runner.recover() == 5
+        assert ck.latest_epoch == 2
+        assert ck.record(1).encode() == static_ckpt.record(1).encode()
+    finally:
+        engine.messages.close()
+
+
+# -- the wire path: GET /checkpoints end to end ------------------------------
+
+
+@pytest.fixture(scope="module")
+def checkpoint_api(rot_chain, rot_ckpt):
+    source = _ListSource(rot_chain)
+    proofs = ProofServer(
+        ProofBuilder(source, _validators), ProofCache(chunk_heights=4)
+    )
+    api = ProofApiServer(
+        proofs,
+        source.latest_height,
+        port=0,
+        checkpoints_fn=rot_ckpt.wire_payload,
+    )
+    api.start()
+    yield api
+    api.stop()
+    proofs.close()
+
+
+def test_http_cold_sync_anchors_across_rotation(checkpoint_api):
+    client = CheckpointClient(checkpoint_api.url, _bls_pubkeys)
+    before = _dispatches()
+    report = client.cold_sync(_powers(_SET_A))
+    assert report.anchor_height == 16 and report.anchor_epoch == 4
+    assert report.target == 16 and report.tail_bytes == 0
+    assert report.powers == _powers(_SET_B)
+    assert report.checkpoint_lanes == len(skip_path(4)) == 3
+    assert report.bridge_bytes > 0  # the commitment-enforced rotation bridge
+    assert report.pairing_dispatches == 1
+    assert _dispatches() - before == 1
+
+
+def test_http_cold_sync_tail_past_anchor(checkpoint_api):
+    report = CheckpointClient(checkpoint_api.url, _bls_pubkeys).cold_sync(
+        _powers(_SET_A), target=14
+    )
+    assert report.anchor_height == 12 and report.anchor_epoch == 3
+    assert report.tail_heights == 2 and report.tail_bytes > 0
+    assert report.powers == _powers(_SET_B)
+
+
+def test_wire_splice_attack_dies_at_commitment_check(checkpoint_api):
+    """The full attack on real bytes: fetch an honest proof over the
+    rotation range, splice a fabricated diff, verify as a client would."""
+    client = CheckpointClient(checkpoint_api.url, _bls_pubkeys)
+    payload, _n = client.fetch_proof(8, 16)
+    spliced = json.loads(json.dumps(payload["proof"]))
+    spliced["diffs"].append(
+        {"height": 15, "added": {"ab" * 20: 1000}, "removed": []}
+    )
+    verifier = ProofVerifier(require_commitments=True)
+    with pytest.raises(ProofError, match="next-set root"):
+        verifier.verify(FinalityProof.from_wire(spliced), _powers(_SET_A))
+    # the unspliced bytes verify through the exact same path
+    verifier.verify(FinalityProof.from_wire(payload["proof"]), _powers(_SET_A))
+
+
+def test_checkpoints_endpoint_wire_behaviors(checkpoint_api):
+    client = CheckpointClient(checkpoint_api.url, _bls_pubkeys)
+    payload, _n = client.fetch_checkpoints(target_epoch=2)
+    assert [r.epoch for r in _decoded(payload)] == [1, 2]
+    assert payload["latest_epoch"] == 4 and payload["head"] == 16
+    full, _n = client.fetch_checkpoints(include_all=True)
+    assert len(full["checkpoints"]) == 4
+    with pytest.raises(CheckpointError, match="416"):
+        client.fetch_checkpoints(target_epoch=99)
+    with pytest.raises(CheckpointError, match="400"):
+        http_fetcher(checkpoint_api.url)("/checkpoints?epoch=nope")
+
+
+def test_checkpoints_endpoint_404_when_not_wired():
+    class _NoProofs:
+        def get_proof(self, checkpoint, target=None):
+            raise AssertionError("never called")
+
+    api = ProofApiServer(_NoProofs(), lambda: 5, port=0)
+    api.start()
+    try:
+        with pytest.raises(CheckpointError, match="404"):
+            http_fetcher(api.url)("/checkpoints")
+    finally:
+        api.stop()
